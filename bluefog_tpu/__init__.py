@@ -1,0 +1,36 @@
+"""bluefog_tpu: decentralized deep-learning training, TPU-native.
+
+A ground-up JAX/XLA re-design of the capabilities of Bluefog
+(https://github.com/Bluefog-Lib/bluefog): virtual-topology gossip averaging
+(static, dynamic, and hierarchical) compiled to ``ppermute``/``psum``
+collectives over an ICI/DCN device mesh instead of MPI/NCCL background
+threads.
+
+Typical use::
+
+    import bluefog_tpu as bf
+    bf.init(topology_fn=lambda: bf.topology.ExponentialTwoGraph(8))
+    x_avg = bf.neighbor_allreduce(x)          # x: [n_ranks, ...]
+"""
+from . import topology
+from . import topology as topology_util       # reference-familiar alias
+from . import schedule
+from . import ops
+from .parallel import (
+    init, shutdown, is_initialized,
+    size, local_size, machine_size,
+    mesh, mesh_2d, devices,
+    load_topology, is_topology_weighted, set_topology,
+    load_machine_topology, is_machine_topology_weighted, set_machine_topology,
+    in_neighbor_ranks, out_neighbor_ranks,
+    in_neighbor_machine_ranks, out_neighbor_machine_ranks,
+    static_schedule, machine_schedule, get_context,
+)
+from .api import (
+    allreduce, allgather, broadcast,
+    neighbor_allreduce, neighbor_allgather,
+    pair_gossip, hierarchical_neighbor_allreduce,
+    barrier, synchronize, poll, resolve_schedule, shard_distributed,
+)
+
+__version__ = "0.1.0"

@@ -1,0 +1,307 @@
+"""Blocking op API over distributed tensors.
+
+User-facing equivalent of ``bluefog/torch/mpi_ops.py``.  A *distributed
+tensor* is a global array whose leading axis is the rank axis: ``x[i]`` is
+rank i's value, sharded over the mesh (``PartitionSpec('rank')``).  Every op
+wraps the SPMD primitives from :mod:`bluefog_tpu.ops` in ``shard_map`` over
+the context mesh, jit-compiles once per (op, schedule, shape, dtype) and
+caches the executable — the compiled-program analogue of the reference's
+fusion/negotiation machinery (there is nothing to negotiate: the program *is*
+the agreement).
+
+Nonblocking variants are deliberately absent: JAX dispatch is asynchronous
+already, so ``neighbor_allreduce`` returns immediately with a future-backed
+array; ``synchronize(x)`` (= ``block_until_ready``) and ``poll(x)`` give the
+reference's handle semantics (``mpi_ops.py:962-1005``) without a handle table.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from . import ops
+from .parallel import context as _mesh
+from .schedule import CommSchedule, compile_from_weights
+
+__all__ = [
+    "allreduce", "allgather", "broadcast", "neighbor_allreduce",
+    "neighbor_allgather", "pair_gossip", "hierarchical_neighbor_allreduce",
+    "barrier", "synchronize", "poll", "resolve_schedule", "shard_distributed",
+]
+
+_jit_cache: Dict = {}
+
+
+def _cached(key, build):
+    fn = _jit_cache.get(key)
+    if fn is None:
+        fn = _jit_cache[key] = build()
+    return fn
+
+
+def _per_rank(inner):
+    """Lift a per-rank-value op to a [1, ...] mesh block."""
+    def f(block, *args, **kwargs):
+        return inner(block[0], *args, **kwargs)[None]
+    return f
+
+
+def _shard_map_1d(inner, mesh: Mesh):
+    return jax.jit(jax.shard_map(
+        inner, mesh=mesh, in_specs=P("rank"), out_specs=P("rank")))
+
+
+def _shard_map_2d(inner, mesh: Mesh):
+    return jax.jit(jax.shard_map(
+        inner, mesh=mesh,
+        in_specs=P(("machine", "local")), out_specs=P(("machine", "local"))))
+
+
+def _check_distributed(x, n: int):
+    if x.shape[0] != n:
+        raise ValueError(
+            f"distributed tensor must have leading rank axis of size {n}, "
+            f"got shape {x.shape}")
+
+
+def shard_distributed(x: jax.Array) -> jax.Array:
+    """Place a distributed tensor on the mesh, sharded along the rank axis."""
+    ctx = _mesh.get_context()
+    _check_distributed(x, ctx.size)
+    return jax.device_put(x, NamedSharding(ctx.mesh, P("rank")))
+
+
+# ---------------------------------------------------------------------------
+# Weight-policy resolution (reference: mpi_ops.py:482-535)
+# ---------------------------------------------------------------------------
+
+def resolve_schedule(
+    self_weight: Optional[Union[float, Sequence[float]]] = None,
+    src_weights: Optional[Sequence[Dict[int, float]]] = None,
+    dst_weights: Optional[Sequence[Union[Dict[int, float], List[int]]]] = None,
+    schedule: Optional[CommSchedule] = None,
+    *,
+    size: Optional[int] = None,
+    default_schedule=None,
+) -> CommSchedule:
+    """Resolve neighbor-op weights to a compiled schedule.
+
+    Policy (mirroring the reference):
+      * nothing given -> the static topology schedule (topology weights when
+        the topology was set ``is_weighted``, else uniform 1/(in_degree+1));
+      * ``schedule`` given -> used as-is (the idiomatic dynamic-topology path:
+        precompile with :func:`bluefog_tpu.schedule.compile_dynamic_schedules`);
+      * explicit weights -> ``self_weight`` (scalar or per-rank), per-rank
+        ``src_weights`` dicts, optional per-rank ``dst_weights`` (lists mean
+        scale 1).  Both of ``self_weight``/``src_weights`` must be present
+        together, and ``dst_weights`` requires both — same contract as the
+        reference.
+    """
+    if schedule is not None:
+        if self_weight is not None or src_weights is not None or dst_weights is not None:
+            raise ValueError("pass either a schedule or explicit weights, not both")
+        return schedule
+    if self_weight is None and src_weights is None:
+        if dst_weights is not None:
+            raise ValueError(
+                "self_weight and src_weights must be given when dst_weights is used")
+        return (default_schedule or _mesh.static_schedule)()
+    if self_weight is None or src_weights is None:
+        raise ValueError(
+            "self_weight and src_weights must be presented at the same time")
+
+    n = _mesh.size() if size is None else size
+    if np.isscalar(self_weight):
+        self_weights = [float(self_weight)] * n
+    else:
+        self_weights = [float(w) for w in self_weight]
+    if isinstance(src_weights, dict):
+        raise ValueError(
+            "src_weights must be a per-rank sequence of {src_rank: weight} "
+            "dicts (the SPMD program needs every rank's weights)")
+    src_list = [dict(d) for d in src_weights]
+
+    dst_list = None
+    if dst_weights is not None:
+        dst_list = []
+        for d in dst_weights:
+            if isinstance(d, dict):
+                dst_list.append({int(k): float(v) for k, v in d.items()})
+            else:
+                dst_list.append({int(k): 1.0 for k in d})
+    return compile_from_weights(n, self_weights, src_list, dst_list)
+
+
+# ---------------------------------------------------------------------------
+# Ops
+# ---------------------------------------------------------------------------
+
+def neighbor_allreduce(
+    x: jax.Array,
+    *,
+    self_weight=None,
+    src_weights=None,
+    dst_weights=None,
+    schedule: Optional[CommSchedule] = None,
+) -> jax.Array:
+    """Weighted neighbor averaging of each rank's slice (the flagship op).
+
+    Reference: ``bf.neighbor_allreduce`` (``mpi_ops.py:540-592``).
+    """
+    ctx = _mesh.get_context()
+    _check_distributed(x, ctx.size)
+    sched = resolve_schedule(self_weight, src_weights, dst_weights, schedule)
+    fn = _cached(
+        ("nar", sched, ctx.mesh, x.shape, x.dtype.name),
+        lambda: _shard_map_1d(
+            _per_rank(partial(ops.neighbor_allreduce, sched=sched, axis="rank")),
+            ctx.mesh))
+    return fn(x)
+
+
+def neighbor_allgather(
+    x: jax.Array,
+    *,
+    self_weight=None,
+    src_weights=None,
+    dst_weights=None,
+    schedule: Optional[CommSchedule] = None,
+) -> jax.Array:
+    """Concatenate in-neighbor slices along each rank's first value dim.
+
+    Output shape ``[n, max_in_degree * d0, ...]``; slots beyond a rank's
+    in-degree are zero (regular topologies fill every slot).  Reference:
+    ``bf.neighbor_allgather`` (``mpi_ops.py:396-476``).
+    """
+    ctx = _mesh.get_context()
+    _check_distributed(x, ctx.size)
+    if x.ndim < 2:
+        raise ValueError("neighbor_allgather needs a per-rank first dimension")
+    sched = resolve_schedule(self_weight, src_weights, dst_weights, schedule)
+    fn = _cached(
+        ("nag", sched, ctx.mesh, x.shape, x.dtype.name),
+        lambda: _shard_map_1d(
+            _per_rank(partial(ops.neighbor_allgather, sched=sched, axis="rank")),
+            ctx.mesh))
+    return fn(x)
+
+
+def allreduce(x: jax.Array, average: bool = True) -> jax.Array:
+    """Global (weighted-uniform) allreduce. Reference: ``bf.allreduce``."""
+    ctx = _mesh.get_context()
+    _check_distributed(x, ctx.size)
+    fn = _cached(
+        ("ar", average, ctx.mesh, x.shape, x.dtype.name),
+        lambda: _shard_map_1d(
+            _per_rank(partial(ops.allreduce, average=average, axis="rank")),
+            ctx.mesh))
+    return fn(x)
+
+
+def allgather(x: jax.Array) -> jax.Array:
+    """All ranks receive the concatenation of all slices: ``[n, n*d0, ...]``."""
+    ctx = _mesh.get_context()
+    _check_distributed(x, ctx.size)
+    if x.ndim < 2:
+        raise ValueError("allgather needs a per-rank first dimension")
+    fn = _cached(
+        ("ag", ctx.mesh, x.shape, x.dtype.name),
+        lambda: _shard_map_1d(
+            _per_rank(partial(ops.allgather, axis="rank")), ctx.mesh))
+    return fn(x)
+
+
+def broadcast(x: jax.Array, root_rank: int) -> jax.Array:
+    """Every rank's slice becomes root's slice. Reference: ``bf.broadcast``."""
+    ctx = _mesh.get_context()
+    _check_distributed(x, ctx.size)
+    fn = _cached(
+        ("bc", root_rank, ctx.mesh, x.shape, x.dtype.name),
+        lambda: _shard_map_1d(
+            _per_rank(partial(ops.broadcast, root_rank=root_rank, axis="rank")),
+            ctx.mesh))
+    return fn(x)
+
+
+def pair_gossip(
+    x: jax.Array,
+    partners: Sequence[int],
+    *,
+    self_weight: float = 0.5,
+    pair_weight: float = 0.5,
+) -> jax.Array:
+    """Paired exchange-and-average. Reference: ``bf.pair_gossip``."""
+    ctx = _mesh.get_context()
+    _check_distributed(x, ctx.size)
+    key = ("pg", tuple(int(p) for p in partners), float(self_weight),
+           float(pair_weight), ctx.mesh, x.shape, x.dtype.name)
+    fn = _cached(
+        key,
+        lambda: _shard_map_1d(
+            _per_rank(partial(
+                ops.pair_gossip, partners=tuple(int(p) for p in partners),
+                self_weight=self_weight, pair_weight=pair_weight, axis="rank")),
+            ctx.mesh))
+    return fn(x)
+
+
+def hierarchical_neighbor_allreduce(
+    x: jax.Array,
+    *,
+    self_weight=None,
+    src_machine_weights=None,
+    dst_machine_weights=None,
+    schedule: Optional[CommSchedule] = None,
+) -> jax.Array:
+    """Machine-level neighbor averaging (reference: ``mpi_ops.py:848-864``).
+
+    Intra-machine average over the ``local`` mesh axis, then machine-level
+    gossip over the ``machine`` axis; the result is replicated within each
+    machine.
+    """
+    ctx = _mesh.get_context()
+    _check_distributed(x, ctx.size)
+    # Machine-weight resolution reuses the rank policy at machine scope.
+    sched = resolve_schedule(
+        self_weight, src_machine_weights, dst_machine_weights, schedule,
+        size=ctx.machine_size, default_schedule=_mesh.machine_schedule)
+    fn = _cached(
+        ("hnar", sched, ctx.mesh_2d, x.shape, x.dtype.name),
+        lambda: _shard_map_2d(
+            _per_rank(partial(
+                ops.hierarchical_neighbor_allreduce, machine_sched=sched,
+                machine_axis="machine", local_axis="local")),
+            ctx.mesh_2d))
+    return fn(x)
+
+
+# ---------------------------------------------------------------------------
+# Synchronization (reference handle semantics without handles)
+# ---------------------------------------------------------------------------
+
+def synchronize(x):
+    """Block until the async computation backing ``x`` is done; returns ``x``.
+
+    Reference: ``bf.synchronize(handle)`` — JAX arrays *are* the handles.
+    """
+    return jax.block_until_ready(x)
+
+
+def poll(x) -> bool:
+    """True if ``x``'s computation has completed (reference: ``bf.poll``)."""
+    leaves = jax.tree_util.tree_leaves(x)
+    return all(leaf.is_ready() for leaf in leaves if hasattr(leaf, "is_ready"))
+
+
+def barrier():
+    """Synchronize all pending work (reference: ``bf.barrier``).
+
+    Under SPMD every compiled program is already a global synchronization
+    point; this only drains the host dispatch queue.
+    """
+    (jax.device_put(0) + 0).block_until_ready()

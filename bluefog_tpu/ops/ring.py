@@ -1,0 +1,121 @@
+"""Ring primitives: rotation, ring allreduce, and ring attention.
+
+The reference exposes ring exchanges only as topology patterns
+(``RingGraph`` + the inner/outer ring dynamic generators,
+``topology_util.py:240-281,399-463``).  Here the ring ``ppermute`` schedule is
+a first-class reusable primitive, which also powers long-context *sequence
+parallelism*: :func:`ring_attention` shards the sequence over a mesh axis and
+rotates key/value blocks around the ring with a numerically-stable online
+softmax — the same collective pattern as neighbor gossip, applied to
+attention.  This is the capability the reference's architecture points at but
+predates (SURVEY.md §5 "long-context").
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+Axis = str
+
+
+def _ring_perm(n: int, shift: int = 1) -> Tuple[Tuple[int, int], ...]:
+    return tuple((i, (i + shift) % n) for i in range(n))
+
+
+def ring_pass(x: jax.Array, *, axis: Axis = "rank", shift: int = 1) -> jax.Array:
+    """Rotate blocks around the mesh axis: device i receives from i - shift."""
+    n = lax.axis_size(axis)
+    return lax.ppermute(x, axis, perm=_ring_perm(n, shift))
+
+
+def ring_allreduce(x: jax.Array, *, average: bool = False, axis: Axis = "rank") -> jax.Array:
+    """Bandwidth-optimal ring allreduce: reduce-scatter + allgather.
+
+    Provided for algorithm-comparison benchmarks (the reference compares its
+    gossip against Horovod's ring allreduce, ``README.rst:26-34``).  For
+    production use prefer :func:`~bluefog_tpu.ops.allreduce` (``psum``), which
+    XLA already lowers to the optimal ICI algorithm.
+    """
+    n = lax.axis_size(axis)
+    flat = x.reshape(-1)
+    pad = (-flat.shape[0]) % n
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    reduced = lax.psum_scatter(flat, axis, scatter_dimension=0, tiled=True)
+    if average:
+        reduced = reduced / n
+    out = lax.all_gather(reduced, axis, tiled=True)
+    if pad:
+        out = out[:-pad]
+    return out.reshape(x.shape)
+
+
+@partial(jax.named_call, name="ring_attention")
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis: Axis = "rank",
+    causal: bool = False,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Exact attention over a sequence sharded along ``axis``.
+
+    Blocks: ``q, k, v`` have shape ``[batch, block_len, heads, head_dim]``
+    (this device's slice of the sequence).  K/V blocks rotate around the ring;
+    each step contributes one block of scores folded in with the online
+    (flash-style) softmax, so memory stays O(block²) while the sequence length
+    scales with the number of devices.  Returns this device's output block.
+    """
+    if q.ndim != 4:
+        raise ValueError("expected [batch, block_len, heads, head_dim]")
+    n = lax.axis_size(axis)
+    idx = lax.axis_index(axis)
+    d = q.shape[-1]
+    if scale is None:
+        scale = 1.0 / np.sqrt(d)
+    blk_q, blk_k = q.shape[1], k.shape[1]
+
+    qf = q.astype(jnp.float32) * scale
+    perm = _ring_perm(n, 1)
+
+    # pcast: mark accumulators as varying over the ring axis so the scan
+    # carry type matches (shard_map tracks varying-manual-axes in jax >= 0.9)
+    o0 = lax.pcast(jnp.zeros(q.shape, jnp.float32), axis, to='varying')
+    l0 = lax.pcast(jnp.zeros(q.shape[:3], jnp.float32), axis, to='varying')    # [B, Tq, H]
+    m0 = lax.pcast(jnp.full(q.shape[:3], -jnp.inf, jnp.float32), axis, to='varying')
+
+    q_pos = idx * blk_q + jnp.arange(blk_q)                      # global positions
+
+    def step(carry, t):
+        o, l, m, kt, vt = carry
+        src = (idx - t) % n                                      # owner of current kv block
+        # scores[b, i, h, j] = qf[b,i,h,:] . kt[b,j,h,:]
+        s = jnp.einsum("bihd,bjhd->bihj", qf, kt.astype(jnp.float32))
+        if causal:
+            k_pos = src * blk_k + jnp.arange(blk_k)
+            mask = q_pos[:, None, None] >= k_pos[None, None, :]  # [Tq, 1, Tk]
+            s = jnp.where(mask[None], s, -jnp.inf)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        # exp(-inf - -inf) guard: rows with no valid keys keep m = -inf
+        safe_m = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+        p = jnp.exp(s - safe_m[..., None])
+        if causal:
+            p = jnp.where(jnp.isneginf(s), 0.0, p)
+        corr = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - safe_m))
+        l = l * corr + p.sum(axis=-1)
+        o = o * corr[..., None] + jnp.einsum(
+            "bihj,bjhd->bihd", p, vt.astype(jnp.float32))
+        kt = lax.ppermute(kt, axis, perm=perm)
+        vt = lax.ppermute(vt, axis, perm=perm)
+        return (o, l, m_new, kt, vt), None
+
+    (o, l, _, _, _), _ = lax.scan(step, (o0, l0, m0, k, v), jnp.arange(n))
+    l = jnp.where(l == 0.0, 1.0, l)                              # fully-masked rows
+    return (o / l[..., None]).astype(q.dtype)

@@ -1,0 +1,240 @@
+"""Global context: device mesh + virtual topology state.
+
+TPU-native replacement for the reference's init/global-state machinery
+(``bluefog/common/basics.py`` + ``operations.cc:1189-1326``).  There is no
+background communication thread and no ctypes boundary: ``init`` builds a
+``jax.sharding.Mesh`` over the devices (and a 2-D machine x local mesh for
+hierarchical ops), and topology state lives in one process-level context whose
+schedules are compiled lazily and cached.
+
+Rank semantics under SPMD: a device's rank is its index along the mesh's
+``rank`` axis (``ops.my_rank()`` inside shard_map).  Host-side code sees the
+*global* picture — per-rank values are arrays with a leading rank axis —
+so accessors like ``in_neighbor_ranks`` take the rank as an argument instead
+of reading an ambient "my rank" (reference: ``basics.py:200-265``).
+"""
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Callable, List, Optional
+
+import jax
+import numpy as np
+import networkx as nx
+from jax.sharding import Mesh
+
+from .. import topology as topo_util
+from ..schedule import CommSchedule, compile_topology
+
+_lock = threading.Lock()
+_context: Optional["BlueFogTpuContext"] = None
+
+
+@dataclass
+class BlueFogTpuContext:
+    devices: np.ndarray                       # flat, rank-ordered
+    nodes_per_machine: int
+    mesh: Mesh                                # 1-D ('rank',)
+    mesh_2d: Mesh                             # 2-D ('machine', 'local')
+    topology: Optional[nx.DiGraph] = None
+    topology_weighted: bool = False
+    machine_topology: Optional[nx.DiGraph] = None
+    machine_topology_weighted: bool = False
+    _sched: Optional[CommSchedule] = None
+    _machine_sched: Optional[CommSchedule] = None
+
+    @property
+    def size(self) -> int:
+        return len(self.devices)
+
+    @property
+    def machine_size(self) -> int:
+        return self.size // self.nodes_per_machine
+
+    def static_schedule(self) -> CommSchedule:
+        if self.topology is None:
+            raise RuntimeError("no topology set; call bf.init() / bf.set_topology()")
+        if self._sched is None:
+            self._sched = compile_topology(self.topology, weighted=self.topology_weighted)
+        return self._sched
+
+    def machine_schedule(self) -> CommSchedule:
+        if self.machine_topology is None:
+            raise RuntimeError("no machine topology set; call bf.set_machine_topology()")
+        if self._machine_sched is None:
+            self._machine_sched = compile_topology(
+                self.machine_topology, weighted=self.machine_topology_weighted)
+        return self._machine_sched
+
+
+def init(
+    topology_fn: Optional[Callable[[], nx.DiGraph]] = None,
+    is_weighted: bool = False,
+    *,
+    devices: Optional[List] = None,
+    platform: Optional[str] = None,
+    nodes_per_machine: Optional[int] = None,
+) -> BlueFogTpuContext:
+    """Initialize the context (reference: ``bf.init``, ``basics.py:49-70``).
+
+    Args:
+      topology_fn: zero-arg callable returning the virtual topology; defaults
+        to ``ExponentialGraph(size)`` like the reference.
+      is_weighted: use the topology's mixing weights for neighbor averaging
+        instead of the uniform ``1/(in_degree+1)`` default.
+      devices: explicit device list (rank order).  Default: ``jax.devices()``.
+      platform: select a backend explicitly (e.g. ``"cpu"`` for the 8-device
+        virtual-mesh test fixture).
+      nodes_per_machine: devices per "machine" for hierarchical ops.  Default:
+        ``jax.local_device_count()`` when multi-process, else the device count
+        (single host = one machine).  The reference's
+        ``BLUEFOG_NODES_PER_MACHINE`` virtual-machine split maps here.
+    """
+    global _context
+    if devices is None:
+        devices = jax.devices(platform) if platform else jax.devices()
+    devs = np.asarray(devices, dtype=object)
+    n = len(devs)
+    if nodes_per_machine is None:
+        nodes_per_machine = jax.local_device_count() if jax.process_count() > 1 else n
+    if n % nodes_per_machine != 0:
+        raise ValueError(
+            f"device count {n} not divisible by nodes_per_machine {nodes_per_machine}")
+
+    mesh = Mesh(devs, ("rank",))
+    mesh_2d = Mesh(devs.reshape(n // nodes_per_machine, nodes_per_machine),
+                   ("machine", "local"))
+    ctx = BlueFogTpuContext(
+        devices=devs, nodes_per_machine=nodes_per_machine,
+        mesh=mesh, mesh_2d=mesh_2d)
+
+    topo = topology_fn() if topology_fn is not None else topo_util.ExponentialGraph(n)
+    ctx.topology = _check_topology(topo, n)
+    ctx.topology_weighted = is_weighted
+
+    with _lock:
+        _context = ctx
+    return ctx
+
+
+def _check_topology(topo: nx.DiGraph, size: int) -> nx.DiGraph:
+    if topo.number_of_nodes() != size:
+        raise ValueError(
+            f"topology has {topo.number_of_nodes()} nodes but the mesh has {size} devices")
+    return topo
+
+
+def get_context() -> BlueFogTpuContext:
+    if _context is None:
+        raise RuntimeError("bluefog_tpu is not initialized; call bf.init() first")
+    return _context
+
+
+def shutdown() -> None:
+    """Drop the context (reference: ``bf.shutdown``)."""
+    global _context
+    with _lock:
+        _context = None
+
+
+def is_initialized() -> bool:
+    return _context is not None
+
+
+def size() -> int:
+    return get_context().size
+
+
+def local_size() -> int:
+    return get_context().nodes_per_machine
+
+
+def machine_size() -> int:
+    return get_context().machine_size
+
+
+def devices() -> np.ndarray:
+    return get_context().devices
+
+
+def mesh() -> Mesh:
+    return get_context().mesh
+
+
+def mesh_2d() -> Mesh:
+    return get_context().mesh_2d
+
+
+def load_topology() -> nx.DiGraph:
+    return get_context().topology
+
+
+def is_topology_weighted() -> bool:
+    return get_context().topology_weighted
+
+
+def set_topology(topology: Optional[nx.DiGraph] = None,
+                 is_weighted: bool = False) -> bool:
+    """Replace the virtual topology (reference: ``basics.py:311-419``).
+
+    Unlike the reference there is no open-window restriction: window state is
+    explicit and schedules are compiled per topology, so changing topology
+    simply invalidates the cached schedule.
+    """
+    ctx = get_context()
+    if topology is None:
+        topology = topo_util.ExponentialGraph(ctx.size)
+    ctx.topology = _check_topology(topology, ctx.size)
+    ctx.topology_weighted = is_weighted
+    ctx._sched = None
+    return True
+
+
+def load_machine_topology() -> Optional[nx.DiGraph]:
+    return get_context().machine_topology
+
+
+def is_machine_topology_weighted() -> bool:
+    return get_context().machine_topology_weighted
+
+
+def set_machine_topology(topology: nx.DiGraph, is_weighted: bool = False) -> bool:
+    """Set the machine-level topology for hierarchical ops (reference:
+    ``basics.py:267-309``)."""
+    ctx = get_context()
+    ctx.machine_topology = _check_topology(topology, ctx.machine_size)
+    ctx.machine_topology_weighted = is_weighted
+    ctx._machine_sched = None
+    return True
+
+
+def in_neighbor_ranks(rank: int) -> List[int]:
+    """Sorted in-neighbors of ``rank`` in the current topology."""
+    return topo_util.GetInNeighbors(get_context().topology, rank)
+
+
+def out_neighbor_ranks(rank: int) -> List[int]:
+    return topo_util.GetOutNeighbors(get_context().topology, rank)
+
+
+def in_neighbor_machine_ranks(machine_rank: int) -> List[int]:
+    topo = get_context().machine_topology
+    if topo is None:
+        raise RuntimeError("no machine topology set")
+    return topo_util.GetInNeighbors(topo, machine_rank)
+
+
+def out_neighbor_machine_ranks(machine_rank: int) -> List[int]:
+    topo = get_context().machine_topology
+    if topo is None:
+        raise RuntimeError("no machine topology set")
+    return topo_util.GetOutNeighbors(topo, machine_rank)
+
+
+def static_schedule() -> CommSchedule:
+    return get_context().static_schedule()
+
+
+def machine_schedule() -> CommSchedule:
+    return get_context().machine_schedule()

@@ -1,0 +1,84 @@
+"""Hierarchical (machine-level) ops on a virtual 4-machine x 2-local mesh.
+
+Model: reference test/torch_hierarchical_test.py — one host split into
+virtual machines (there via BLUEFOG_NODES_PER_MACHINE, here via
+nodes_per_machine reshaping the mesh).
+"""
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import bluefog_tpu as bf
+from bluefog_tpu import topology as tu
+
+N, L, DIM = 8, 2, 4
+M = N // L
+
+
+@pytest.fixture(autouse=True)
+def ctx(cpu_devices):
+    bf.init(devices=cpu_devices, nodes_per_machine=L)
+    bf.set_machine_topology(tu.RingGraph(M, connect_style=0), is_weighted=True)
+    yield
+    bf.shutdown()
+
+
+def test_sizes():
+    assert bf.size() == N
+    assert bf.local_size() == L
+    assert bf.machine_size() == M
+    assert bf.in_neighbor_machine_ranks(0) == [1, 3]
+
+
+def test_hierarchical_neighbor_allreduce():
+    x = jnp.broadcast_to(
+        jnp.arange(N, dtype=jnp.float32)[:, None], (N, DIM))
+    out = bf.hierarchical_neighbor_allreduce(x)
+    # machine averages: [0.5, 2.5, 4.5, 6.5]; ring(4) weighted combine 1/3 each
+    mavg = np.arange(N, dtype=np.float64).reshape(M, L).mean(axis=1)
+    W = tu.to_weight_matrix(tu.RingGraph(M, connect_style=0))
+    expected_m = W.T @ mavg
+    for r in range(N):
+        np.testing.assert_allclose(
+            np.asarray(out[r]), np.full(DIM, expected_m[r // L]), rtol=1e-5)
+
+
+def test_hierarchical_explicit_machine_weights():
+    x = jnp.broadcast_to(
+        jnp.arange(N, dtype=jnp.float32)[:, None], (N, DIM))
+    out = bf.hierarchical_neighbor_allreduce(
+        x,
+        self_weight=0.5,
+        src_machine_weights=[{(m - 1) % M: 0.5} for m in range(M)],
+        dst_machine_weights=[[(m + 1) % M] for m in range(M)],
+    )
+    mavg = np.arange(N, dtype=np.float64).reshape(M, L).mean(axis=1)
+    for r in range(N):
+        m = r // L
+        expected = 0.5 * mavg[m] + 0.5 * mavg[(m - 1) % M]
+        np.testing.assert_allclose(
+            np.asarray(out[r]), np.full(DIM, expected), rtol=1e-5)
+
+
+def test_hierarchical_consensus():
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.normal(size=(N, DIM)), dtype=jnp.float32)
+    mean = np.asarray(x).mean(axis=0)
+    for _ in range(40):
+        x = bf.synchronize(bf.hierarchical_neighbor_allreduce(x))
+    np.testing.assert_allclose(np.asarray(x), np.tile(mean, (N, 1)), atol=1e-4)
+
+
+def test_hierarchical_weight_validation():
+    """Validation parity with the flat op (regression: these paths used to
+    silently mis-resolve or raise raw TypeErrors)."""
+    x = jnp.ones((N, DIM))
+    with pytest.raises(ValueError, match="presented at the same time"):
+        bf.hierarchical_neighbor_allreduce(x, self_weight=0.5)
+    with pytest.raises(ValueError, match="dst_weights"):
+        bf.hierarchical_neighbor_allreduce(
+            x, dst_machine_weights=[{(m + 1) % M: 2.0} for m in range(M)])
+    with pytest.raises(ValueError, match="not both"):
+        bf.hierarchical_neighbor_allreduce(
+            x, schedule=bf.machine_schedule(), self_weight=0.5,
+            src_machine_weights=[{(m - 1) % M: 0.5} for m in range(M)])

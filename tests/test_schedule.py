@@ -1,0 +1,95 @@
+"""Schedule compiler tests: topology -> ppermute rounds."""
+import numpy as np
+import pytest
+
+from bluefog_tpu import schedule as sch
+from bluefog_tpu import topology as tu
+
+
+def _check_rounds_are_partial_permutations(rounds):
+    for rnd in rounds:
+        srcs = [s for s, _ in rnd]
+        dsts = [d for _, d in rnd]
+        assert len(set(srcs)) == len(srcs)
+        assert len(set(dsts)) == len(dsts)
+
+
+def test_circulant_decomposition_is_optimal():
+    """Exp2(8) has out-degree 3 -> exactly 3 full-permutation rounds."""
+    sched = sch.compile_topology(tu.ExponentialTwoGraph(8))
+    assert sched.num_rounds == 3
+    for rnd in sched.rounds:
+        assert len(rnd) == 8  # full permutation: every device sends
+    _check_rounds_are_partial_permutations(sched.rounds)
+
+
+def test_ring_one_round_per_direction():
+    sched = sch.compile_topology(tu.RingGraph(8, connect_style=2))
+    assert sched.num_rounds == 1
+    assert set(sched.rounds[0]) == {(i, (i + 1) % 8) for i in range(8)}
+
+
+def test_star_coloring_valid():
+    sched = sch.compile_topology(tu.StarGraph(8))
+    _check_rounds_are_partial_permutations(sched.rounds)
+    # center sends to 7 leaves -> at least 7 rounds; every edge appears once
+    all_edges = [e for rnd in sched.rounds for e in rnd]
+    assert len(all_edges) == len(set(all_edges)) == 14
+
+
+def test_tables_match_topology_weights():
+    topo = tu.RingGraph(8, connect_style=0)
+    sched = sch.compile_topology(topo, weighted=True)
+    # Effective combine at rank 3: self*1/3 + left*1/3 + right*1/3
+    sw, nbr = tu.GetRecvWeights(topo, 3)
+    assert sched.self_weight[3] == pytest.approx(sw)
+    got = {}
+    for r in range(sched.num_rounds):
+        src = sched.recv_src[r, 3]
+        if src >= 0:
+            got[int(src)] = got.get(int(src), 0.0) + float(sched.recv_weight[r, 3])
+    assert got == pytest.approx(nbr)
+
+
+def test_unweighted_uniform():
+    topo = tu.ExponentialTwoGraph(8)
+    sched = sch.compile_topology(topo, weighted=False)
+    np.testing.assert_allclose(sched.self_weight, np.full(8, 0.25))
+    assert sched.recv_weight[sched.recv_weight != 0] == pytest.approx(0.25)
+
+
+def test_compile_from_weights_rejects_mismatched_edges():
+    with pytest.raises(ValueError):
+        sch.compile_from_weights(
+            4,
+            self_weights=[0.5] * 4,
+            src_weights_per_rank=[{1: 0.5}, {2: 0.5}, {3: 0.5}, {0: 0.5}],
+            dst_weights_per_rank=[{2: 1.0}, {2: 1.0}, {3: 1.0}, {0: 1.0}],
+        )
+
+
+def test_dynamic_compile_one_ppermute_per_step():
+    topo = tu.ExponentialTwoGraph(8)
+    factory = lambda r: tu.GetDynamicOnePeerSendRecvRanks(topo, r)
+    scheds = sch.compile_dynamic_schedules(factory, 8)
+    assert len(scheds) == 3  # period = out-degree of Exp2(8)
+    for s in scheds:
+        assert s.num_rounds == 1
+        assert len(s.rounds[0]) == 8
+
+
+def test_dynamic_weights_uniform_over_recv():
+    topo = tu.ExponentialTwoGraph(8)
+    factory = lambda r: tu.GetDynamicOnePeerSendRecvRanks(topo, r)
+    s0 = sch.compile_dynamic_schedules(factory, 8)[0]
+    # one-peer: every rank receives exactly one value -> weights 1/2
+    np.testing.assert_allclose(s0.self_weight, np.full(8, 0.5))
+    np.testing.assert_allclose(s0.recv_weight[0], np.full(8, 0.5))
+
+
+def test_schedule_hash_stable():
+    a = sch.compile_topology(tu.ExponentialTwoGraph(8))
+    b = sch.compile_topology(tu.ExponentialTwoGraph(8))
+    c = sch.compile_topology(tu.RingGraph(8))
+    assert a == b and hash(a) == hash(b)
+    assert a != c

@@ -1,0 +1,123 @@
+"""Topology generator tests (model: reference test/torch_basics_test.py)."""
+import numpy as np
+import networkx as nx
+import pytest
+
+from bluefog_tpu import topology as tu
+
+
+ALL_STATIC = [
+    lambda n: tu.ExponentialTwoGraph(n),
+    lambda n: tu.ExponentialGraph(n),
+    lambda n: tu.SymmetricExponentialGraph(n),
+    lambda n: tu.MeshGrid2DGraph(n),
+    lambda n: tu.StarGraph(n),
+    lambda n: tu.RingGraph(n),
+    lambda n: tu.FullyConnectedGraph(n),
+]
+
+
+@pytest.mark.parametrize("gen", ALL_STATIC)
+@pytest.mark.parametrize("size", [1, 2, 4, 8, 12])
+def test_row_stochastic(gen, size):
+    """Every generator emits a row-stochastic mixing matrix."""
+    W = tu.to_weight_matrix(gen(size))
+    np.testing.assert_allclose(W.sum(axis=1), np.ones(size), atol=1e-12)
+
+
+@pytest.mark.parametrize("gen", ALL_STATIC)
+@pytest.mark.parametrize("size", [4, 8])
+def test_doubly_stochastic(gen, size):
+    """The shipped static topologies are doubly stochastic (consensus-preserving)."""
+    W = tu.to_weight_matrix(gen(size))
+    np.testing.assert_allclose(W.sum(axis=0), np.ones(size), atol=1e-12)
+
+
+def test_expo2_neighbors():
+    """Exp2 on 8 nodes: rank r's out-neighbors are r+1, r+2, r+4 (mod 8).
+
+    Mirrors reference test/torch_basics_test.py:130-144.
+    """
+    topo = tu.ExponentialTwoGraph(8)
+    for r in range(8):
+        assert tu.GetOutNeighbors(topo, r) == sorted((r + d) % 8 for d in (1, 2, 4))
+        assert tu.GetInNeighbors(topo, r) == sorted((r - d) % 8 for d in (1, 2, 4))
+
+
+def test_biring_neighbors():
+    """Bidirectional ring: neighbors are r±1 (reference :146-170)."""
+    topo = tu.RingGraph(8, connect_style=0)
+    for r in range(8):
+        assert tu.GetOutNeighbors(topo, r) == sorted({(r + 1) % 8, (r - 1) % 8})
+    topo_l = tu.RingGraph(8, connect_style=1)
+    assert tu.GetOutNeighbors(topo_l, 3) == [2]
+    topo_r = tu.RingGraph(8, connect_style=2)
+    assert tu.GetOutNeighbors(topo_r, 3) == [4]
+
+
+def test_equivalence():
+    assert tu.IsTopologyEquivalent(tu.ExponentialTwoGraph(8), tu.ExponentialTwoGraph(8))
+    assert not tu.IsTopologyEquivalent(tu.ExponentialTwoGraph(8), tu.RingGraph(8))
+    assert not tu.IsTopologyEquivalent(None, tu.RingGraph(8))
+    assert not tu.IsTopologyEquivalent(tu.RingGraph(4), tu.RingGraph(8))
+
+
+def test_regularity():
+    assert tu.IsRegularGraph(tu.RingGraph(8))
+    assert tu.IsRegularGraph(tu.FullyConnectedGraph(8))
+    assert not tu.IsRegularGraph(tu.StarGraph(8))
+
+
+def test_recv_send_weights_star():
+    topo = tu.StarGraph(8, center_rank=0)
+    sw, nbr = tu.GetRecvWeights(topo, 3)
+    assert sw == pytest.approx(1 - 1 / 8)
+    assert nbr == {0: pytest.approx(1 / 8)}
+    sw0, nbr0 = tu.GetRecvWeights(topo, 0)
+    assert sw0 == pytest.approx(1 / 8)
+    assert set(nbr0) == set(range(1, 8))
+
+
+def test_meshgrid_weights():
+    """Hastings weights on a 2x2 grid: all inter-node weights 1/3."""
+    W = tu.to_weight_matrix(tu.MeshGrid2DGraph(4))
+    for i, j in [(0, 1), (0, 2), (1, 3), (2, 3)]:
+        assert W[i, j] == pytest.approx(1 / 3)
+        assert W[j, i] == pytest.approx(1 / 3)
+    assert W[0, 3] == 0.0
+
+
+def test_dynamic_one_peer_matches_recv():
+    """send/recv lists across ranks are mutually consistent each step."""
+    topo = tu.ExponentialTwoGraph(8)
+    gens = [tu.GetDynamicOnePeerSendRecvRanks(topo, r) for r in range(8)]
+    for _ in range(12):
+        step = [next(g) for g in gens]
+        sends = {r: step[r][0] for r in range(8)}
+        recvs = {r: step[r][1] for r in range(8)}
+        for r in range(8):
+            (dst,) = sends[r]
+            assert r in recvs[dst]
+            for src in recvs[r]:
+                assert sends[src] == [r]
+
+
+def test_inner_outer_expo2_consistency():
+    world, local = 16, 4
+    gens = [tu.GetInnerOuterExpo2DynamicSendRecvRanks(world, local, r)
+            for r in range(world)]
+    for _ in range(10):
+        step = [next(g) for g in gens]
+        send = {r: step[r][0][0] for r in range(world)}
+        recv = {r: step[r][1][0] for r in range(world)}
+        # one-peer permutation: sends form a bijection and match recv claims
+        assert sorted(send.values()) == list(range(world))
+        for r in range(world):
+            assert recv[send[r]] == r
+
+
+def test_infer_source_from_destination():
+    dsts = [[1, 2], [2], [0], [0, 1]]
+    srcs = tu.InferSourceFromDestinationRanks(dsts)
+    assert srcs == [[2, 3], [0, 3], [0, 1], []]
+    assert tu.InferDestinationFromSourceRanks(srcs) == [sorted(d) for d in dsts]

@@ -16,6 +16,13 @@ from . import topology
 from . import topology as topology_util       # reference-familiar alias
 from . import schedule
 from . import ops
+from . import optimizers
+from . import utils
+from .utils import (
+    timeline_start_activity, timeline_end_activity, timeline_context,
+    start_timeline, stop_timeline,
+    broadcast_parameters, allreduce_parameters, broadcast_optimizer_state,
+)
 from .parallel import (
     init, shutdown, is_initialized,
     size, local_size, machine_size,
@@ -25,6 +32,10 @@ from .parallel import (
     in_neighbor_ranks, out_neighbor_ranks,
     in_neighbor_machine_ranks, out_neighbor_machine_ranks,
     static_schedule, machine_schedule, get_context,
+    win_create, win_free, win_put, win_accumulate, win_get,
+    win_update, win_update_then_collect, win_mutex, get_win_version,
+    win_associated_p,
+    turn_on_win_ops_with_associated_p, turn_off_win_ops_with_associated_p,
 )
 from .api import (
     allreduce, allgather, broadcast,

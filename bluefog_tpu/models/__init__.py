@@ -1,0 +1,19 @@
+"""Model zoo for the examples/benchmarks (flax.linen, NHWC, bf16-friendly).
+
+Counterparts of the reference example models: the MNIST CNN
+(``examples/pytorch_mnist.py``), ResNet for the synthetic benchmark and
+ImageNet-style training (``examples/pytorch_benchmark.py``,
+``examples/pytorch_resnet.py``), plus a small MLP for optimizer tests and a
+decoder-style transformer block wired for ring-attention sequence
+parallelism (beyond the reference: long-context support).
+"""
+from .mlp import MLP
+from .cnn import MnistCNN
+from .resnet import ResNet, ResNet18, ResNet34, ResNet50
+from .transformer import RingTransformerBlock, RingTransformerLM
+
+__all__ = [
+    "MLP", "MnistCNN",
+    "ResNet", "ResNet18", "ResNet34", "ResNet50",
+    "RingTransformerBlock", "RingTransformerLM",
+]

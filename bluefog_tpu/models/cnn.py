@@ -1,0 +1,26 @@
+"""MNIST CNN (reference: examples/pytorch_mnist.py model).
+
+Same capacity/shape as the reference's 2-conv + 2-fc net; NHWC layout for
+TPU-friendly convolutions.
+"""
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MnistCNN(nn.Module):
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = True):
+        # x: [batch, 28, 28, 1]
+        x = nn.Conv(32, (3, 3), padding="VALID")(x)
+        x = nn.relu(x)
+        x = nn.Conv(64, (3, 3), padding="VALID")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Dropout(0.25, deterministic=not train)(x)
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(128)(x)
+        x = nn.relu(x)
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        return nn.Dense(self.num_classes)(x)

@@ -1,0 +1,85 @@
+"""Decoder transformer with ring-attention sequence parallelism.
+
+Beyond the reference (which predates long-context training, SURVEY.md §5):
+a GPT-style decoder whose attention runs over a sequence SHARDED across the
+mesh — each device holds ``seq_len / n`` tokens and K/V blocks rotate via the
+same ring ``ppermute`` primitive the gossip layer uses
+(:func:`bluefog_tpu.ops.ring_attention`).  Combine with the decentralized
+optimizer strategies for gossip-DP x ring-SP 2-D parallel training.
+"""
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ..ops import ring_attention
+
+
+class RingTransformerBlock(nn.Module):
+    """Pre-LN decoder block; attention is ring-parallel when ``axis`` is set."""
+    num_heads: int
+    mlp_ratio: int = 4
+    axis: Optional[str] = None          # mesh axis the sequence is sharded over
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        # x: [batch, local_seq, d_model]
+        B, T, C = x.shape
+        H = self.num_heads
+        h = nn.LayerNorm(dtype=jnp.float32)(x).astype(self.dtype)
+        qkv = nn.Dense(3 * C, use_bias=False, dtype=self.dtype)(h)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(B, T, H, C // H)
+        k = k.reshape(B, T, H, C // H)
+        v = v.reshape(B, T, H, C // H)
+        if self.axis is not None:
+            att = ring_attention(q, k, v, axis=self.axis, causal=True)
+        else:
+            # single-device fallback: dense causal attention
+            s = jnp.einsum("bihd,bjhd->bihj", q.astype(jnp.float32),
+                           k.astype(jnp.float32)) / jnp.sqrt(C // H)
+            mask = jnp.tril(jnp.ones((T, T), bool))
+            s = jnp.where(mask[None, :, None, :], s, -jnp.inf)
+            p = nn.softmax(s, axis=-1)
+            att = jnp.einsum("bihj,bjhd->bihd", p,
+                             v.astype(jnp.float32)).astype(self.dtype)
+        att = att.reshape(B, T, C)
+        x = x + nn.Dense(C, use_bias=False, dtype=self.dtype)(att)
+
+        h = nn.LayerNorm(dtype=jnp.float32)(x).astype(self.dtype)
+        h = nn.Dense(self.mlp_ratio * C, dtype=self.dtype)(h)
+        h = nn.gelu(h)
+        x = x + nn.Dense(C, dtype=self.dtype)(h)
+        return x
+
+
+class RingTransformerLM(nn.Module):
+    """Small GPT-style LM; input token ids ``[batch, local_seq]``.
+
+    Positions are global: pass ``pos_offset`` = this device's sequence offset
+    (``rank * local_seq``) so rotary-free learned positions line up across the
+    ring.
+    """
+    vocab_size: int = 32000
+    num_layers: int = 4
+    num_heads: int = 8
+    d_model: int = 512
+    max_seq_len: int = 8192
+    axis: Optional[str] = None
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, tokens, pos_offset=0):
+        B, T = tokens.shape
+        x = nn.Embed(self.vocab_size, self.d_model,
+                     dtype=self.dtype)(tokens)
+        pos = nn.Embed(self.max_seq_len, self.d_model, dtype=self.dtype)(
+            pos_offset + jnp.arange(T))
+        x = x + pos[None]
+        for _ in range(self.num_layers):
+            x = RingTransformerBlock(
+                num_heads=self.num_heads, axis=self.axis, dtype=self.dtype)(x)
+        x = nn.LayerNorm(dtype=jnp.float32)(x)
+        return nn.Dense(self.vocab_size, use_bias=False,
+                        dtype=jnp.float32)(x)

@@ -1,0 +1,145 @@
+"""Window (one-sided gossip) ops: buffered per-edge mailboxes under SPMD.
+
+TPU-native re-expression of the reference's MPI RMA windows
+(``mpi_controller.cc:795-1183``) and their NCCL emulation
+(``nccl_controller.cc:1261-1887``).  The reference gives every rank one
+receive buffer per in-neighbor plus its own window tensor
+(``WinTorchStorageManager``, ``mpi_win_ops.cc:83-105``); ``win_put`` /
+``win_accumulate`` / ``win_get`` move data into those buffers one-sidedly and
+``win_update`` combines them.
+
+XLA programs are bulk-synchronous, so *true* asynchrony (a put landing while
+the target computes) is not expressible in one program.  The deliberate design
+decision (SURVEY.md §2.4): window ops are **bounded-staleness buffered
+exchanges** — a put/accumulate/get is delivered at the collective inside the
+compiled step in which it is issued, and ``win_update`` reads whatever the
+buffers hold.  Every algorithmic property the reference's tests rely on
+(push-sum weight conservation, convergence of win_put/pull-get optimizers)
+holds under this model; only wall-clock overlap differs, and that overlap is
+recovered by XLA's async collective scheduling rather than a comm thread.
+
+A :class:`Window` is an explicit pytree (no hidden registry inside jit):
+``value`` is this rank's window tensor, ``recv[k]`` the mailbox for its k-th
+sorted in-neighbor.  All ops are pure: they return the new window.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..schedule import CommSchedule
+
+Axis = str
+
+
+class Window(NamedTuple):
+    """Per-rank window state: own tensor + one mailbox per in-neighbor slot."""
+    value: jax.Array          # [*shape]
+    recv: jax.Array           # [max_in_degree, *shape]
+
+
+def win_create(x: jax.Array, sched: CommSchedule, *, zero_init: bool = True) -> Window:
+    """Allocate a window for ``x`` (reference: ``bf.win_create``).
+
+    ``zero_init`` zeroes the neighbor mailboxes (the reference's default for
+    accumulate windows); the window's own tensor starts as ``x``.
+    """
+    slots = max(sched.max_in_degree, 1)
+    recv = jnp.zeros((slots,) + x.shape, x.dtype)
+    if not zero_init:
+        recv = jnp.broadcast_to(x, recv.shape).astype(x.dtype)
+    return Window(value=x, recv=recv)
+
+
+def _deliver(win: Window, x: jax.Array, sched: CommSchedule, axis: Axis,
+             accumulate: bool, apply_dst_scale: bool = True) -> Window:
+    """Send ``x`` along every out-edge; land in receivers' slot mailboxes."""
+    idx = lax.axis_index(axis)
+    recv = win.recv
+    for r in range(sched.num_rounds):
+        send = x
+        if apply_dst_scale and sched.uses_dst_weighting:
+            send = x * jnp.asarray(sched.send_scale[r])[idx].astype(x.dtype)
+        incoming = lax.ppermute(send, axis, perm=sched.rounds[r])
+        received = jnp.asarray(sched.recv_src[r] >= 0)[idx]
+        slot = jnp.asarray(sched.recv_slot[r])[idx]
+        if accumulate:
+            recv = recv.at[slot].add(jnp.where(received, incoming, 0))
+        else:
+            recv = recv.at[slot].set(jnp.where(received, incoming, recv[slot]))
+    return Window(value=win.value, recv=recv)
+
+
+def win_put(win: Window, x: jax.Array, sched: CommSchedule, *,
+            axis: Axis = "rank") -> Window:
+    """Overwrite out-neighbors' mailboxes with ``x`` (reference: WinPut,
+    ``mpi_controller.cc:952-1032``).  dst-weighting scales per edge."""
+    return _deliver(win, x, sched, axis, accumulate=False)
+
+
+def win_accumulate(win: Window, x: jax.Array, sched: CommSchedule, *,
+                   axis: Axis = "rank") -> Window:
+    """Add ``x`` into out-neighbors' mailboxes (reference: WinAccumulate,
+    ``mpi_controller.cc:1035-1120``)."""
+    return _deliver(win, x, sched, axis, accumulate=True)
+
+
+def win_get(win: Window, sched: CommSchedule, *, axis: Axis = "rank") -> Window:
+    """Fetch in-neighbors' window tensors into this rank's mailboxes
+    (reference: WinGet, ``mpi_controller.cc:1122-1183``).
+
+    Under SPMD a pull is the mirror of a push: every rank sends its current
+    ``value`` along its out-edges.  dst scaling applies to puts, not gets —
+    a get fetches the raw window tensor.
+    """
+    return _deliver(win, win.value, sched, axis, accumulate=False,
+                    apply_dst_scale=False)
+
+
+def win_update(
+    win: Window,
+    sched: CommSchedule,
+    *,
+    axis: Axis = "rank",
+    self_weight: Optional[jax.Array] = None,    # [size] override
+    slot_weights: Optional[jax.Array] = None,   # [max_in_degree, size] override
+    reset: bool = False,
+) -> Tuple[jax.Array, Window]:
+    """Weighted combine of own tensor + mailboxes (reference: ``win_update``,
+    ``mpi_win_ops.cc:345-427``).
+
+    Default weights come from the schedule (topology weights or uniform);
+    overrides support dynamic weighting.  ``reset`` zeroes the mailboxes after
+    the combine (the ``win_update_then_collect`` accumulate pattern).
+    Returns ``(combined_value, new_window)`` with ``new_window.value`` set to
+    the combined value (the reference updates the window tensor in place).
+    """
+    idx = lax.axis_index(axis)
+    dt = win.value.dtype
+    sw_tab = jnp.asarray(sched.self_weight if self_weight is None else self_weight)
+    w_tab = jnp.asarray(sched.slot_weight if slot_weights is None else slot_weights)
+    sw = sw_tab[idx].astype(dt)
+    w = w_tab[:, idx].astype(dt)                      # [K]
+    combined = sw * win.value + jnp.tensordot(w, win.recv.astype(dt), axes=1)
+    recv = jnp.zeros_like(win.recv) if reset else win.recv
+    return combined, Window(value=combined, recv=recv)
+
+
+def win_update_then_collect(
+    win: Window, sched: CommSchedule, *, axis: Axis = "rank",
+) -> Tuple[jax.Array, Window]:
+    """Sum own tensor + all mailboxes, then clear them (reference:
+    ``mpi_ops.py:1064-1080``) — the push-sum collection step."""
+    n = sched.size
+    ones_self = np.ones(n, dtype=np.float32)
+    K = max(sched.max_in_degree, 1)
+    # slot k participates iff k < in_degree (a zero mailbox adds nothing, but
+    # keep the mask exact for clarity)
+    slot_ones = (np.arange(K)[:, None] < sched.in_degree[None, :]).astype(np.float32)
+    return win_update(
+        win, sched, axis=axis,
+        self_weight=ones_self, slot_weights=slot_ones, reset=True)

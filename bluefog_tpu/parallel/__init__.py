@@ -34,3 +34,17 @@ __all__ = [
     "in_neighbor_machine_ranks", "out_neighbor_machine_ranks",
     "static_schedule", "machine_schedule", "get_context",
 ]
+
+from .windows import (
+    win_create, win_free, win_put, win_accumulate, win_get,
+    win_update, win_update_then_collect, win_mutex, get_win_version,
+    win_associated_p,
+    turn_on_win_ops_with_associated_p, turn_off_win_ops_with_associated_p,
+)
+
+__all__ += [
+    "win_create", "win_free", "win_put", "win_accumulate", "win_get",
+    "win_update", "win_update_then_collect", "win_mutex", "get_win_version",
+    "win_associated_p",
+    "turn_on_win_ops_with_associated_p", "turn_off_win_ops_with_associated_p",
+]

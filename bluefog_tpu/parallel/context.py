@@ -92,12 +92,32 @@ def init(
         ``BLUEFOG_NODES_PER_MACHINE`` virtual-machine split maps here.
     """
     global _context
+    from ..utils.config import setup_logging, env_int
+    from ..utils.timeline import maybe_start_from_env
+    setup_logging()
     if devices is None:
-        devices = jax.devices(platform) if platform else jax.devices()
+        if platform is not None:
+            # An explicit platform must also *restrict* backend init: plugins
+            # (e.g. the axon TPU tunnel) can force jax_platforms to include
+            # themselves at interpreter boot, and jax.devices(platform) would
+            # still initialize every listed backend — dialing hardware the
+            # caller asked to avoid.
+            from jax._src import xla_bridge as _xb
+            if not _xb.backends_are_initialized():
+                jax.config.update("jax_platforms", platform)
+            devices = jax.devices(platform)
+        else:
+            # multi-host bootstrap when launched by bfrun-tpu or on a TPU pod
+            from ..run.launcher import maybe_initialize_distributed
+            maybe_initialize_distributed()
+            devices = jax.devices()
     devs = np.asarray(devices, dtype=object)
     n = len(devs)
     if nodes_per_machine is None:
+        nodes_per_machine = env_int("BLUEFOG_NODES_PER_MACHINE")
+    if nodes_per_machine is None:
         nodes_per_machine = jax.local_device_count() if jax.process_count() > 1 else n
+    maybe_start_from_env()
     if n % nodes_per_machine != 0:
         raise ValueError(
             f"device count {n} not divisible by nodes_per_machine {nodes_per_machine}")

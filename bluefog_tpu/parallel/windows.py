@@ -1,0 +1,391 @@
+"""Named-window API over distributed tensors.
+
+User-facing equivalent of the reference's window surface
+(``bluefog/torch/mpi_ops.py:1008-1503``): windows are created by name, puts /
+accumulates / gets move data along the current topology's edges, and
+``win_update`` combines the mailboxes.  State lives in a host-side registry of
+*distributed* :class:`~bluefog_tpu.ops.windows.Window` pytrees (leading rank
+axis), updated functionally by compiled SPMD programs.
+
+Concurrency-safety parity (reference §5 "race detection"): the reference
+needs distributed mutexes and version windows because MPI RMA puts race with
+local reads (``mpi_controller.cc:1238-1392``).  Under SPMD, delivery happens
+at a deterministic point inside the compiled step — there is nothing to race
+with — so ``win_mutex`` is a documented no-op context manager and window
+versions advance deterministically per delivered put (kept for API and
+observability parity).
+"""
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+from dataclasses import dataclass
+from functools import partial
+from typing import Dict, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ..ops import windows as wops
+from ..schedule import CommSchedule, compile_from_weights
+from . import context as _mesh
+
+__all__ = [
+    "win_create", "win_free", "win_put", "win_accumulate", "win_get",
+    "win_update", "win_update_then_collect", "win_mutex", "get_win_version",
+    "win_associated_p", "turn_on_win_ops_with_associated_p",
+    "turn_off_win_ops_with_associated_p",
+]
+
+
+@dataclass
+class _WindowEntry:
+    window: wops.Window          # distributed: value [n,...], recv [n,K,...]
+    sched: CommSchedule          # creation-time schedule (defines slots)
+    version: np.ndarray          # [n, K] puts delivered per mailbox (host-side)
+
+
+_registry: Dict[str, _WindowEntry] = {}
+_assoc_p: Dict[str, wops.Window] = {}    # associated-P scalar channel per window
+_assoc_p_enabled: bool = False
+_jit_cache: Dict = {}
+
+
+def _cached(key, build):
+    fn = _jit_cache.get(key)
+    if fn is None:
+        fn = _jit_cache[key] = build()
+    return fn
+
+
+def _win_specs():
+    return wops.Window(value=P("rank"), recv=P("rank"))
+
+
+def _sm(fn, mesh, in_specs, out_specs):
+    return jax.jit(jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs))
+
+
+def _dst_schedule(base: CommSchedule, dst_weights) -> CommSchedule:
+    """Delivery schedule for a put/accumulate with per-rank dst scaling.
+
+    ``dst_weights`` (per-rank dict or rank list) selects/scales outgoing edges
+    (reference: ``win_put``'s ``dst_weights``, ``mpi_ops.py:1170-1215``).
+    Mailbox slots are REMAPPED onto the window's creation-time layout
+    (``base.in_neighbors``) so a partial delivery lands in the same slot
+    ``win_update`` and version tracking read for that source.
+    """
+    n = base.size
+    dst_list = []
+    for d in dst_weights:
+        if isinstance(d, dict):
+            dst_list.append({int(k): float(v) for k, v in d.items()})
+        else:
+            dst_list.append({int(k): 1.0 for k in d})
+    src_list: List[Dict[int, float]] = [dict() for _ in range(n)]
+    for src, dsts in enumerate(dst_list):
+        for dst in dsts:
+            src_list[dst][src] = 1.0   # recv weights irrelevant for delivery
+    sub = compile_from_weights(n, [0.0] * n, src_list, dst_list)
+
+    recv_slot = sub.recv_slot.copy()
+    for r in range(recv_slot.shape[0]):
+        for dst in range(n):
+            src = int(sub.recv_src[r, dst])
+            if src < 0:
+                continue
+            if src not in base.in_neighbors[dst]:
+                raise ValueError(
+                    f"rank {src} -> {dst} is not an edge of the window's "
+                    f"topology; dst_weights may only select existing edges")
+            recv_slot[r, dst] = base.in_neighbors[dst].index(src)
+    return dataclasses.replace(sub, recv_slot=recv_slot, key="")
+
+
+def _slot_table_from_weights(base: CommSchedule,
+                             neighbor_weights: Sequence[Dict[int, float]]) -> np.ndarray:
+    """Per-rank {src: w} dicts -> [max_in_degree, n] slot-weight table, laid
+    out on the window's canonical slot order (``base.in_neighbors``)."""
+    n = base.size
+    K = max(base.max_in_degree, 1)
+    table = np.zeros((K, n), dtype=np.float32)
+    for dst, weights in enumerate(neighbor_weights):
+        for src, w in weights.items():
+            if src not in base.in_neighbors[dst]:
+                raise ValueError(
+                    f"rank {dst}: {src} is not an in-neighbor in this window")
+            table[base.in_neighbors[dst].index(src), dst] = float(w)
+    return table
+
+
+# ---------------------------------------------------------------------------
+# Window lifecycle
+# ---------------------------------------------------------------------------
+
+def win_create(tensor: jax.Array, name: str, zero_init: bool = False) -> bool:
+    """Create a named window over a distributed tensor (reference:
+    ``bf.win_create``, ``mpi_ops.py:1008-1040``)."""
+    ctx = _mesh.get_context()
+    if tensor.shape[0] != ctx.size:
+        raise ValueError(
+            f"window tensor must have leading rank axis {ctx.size}, got {tensor.shape}")
+    sched = _mesh.static_schedule()
+    fn = _cached(
+        ("create", sched, ctx.mesh, tensor.shape, tensor.dtype.name, zero_init),
+        lambda: _sm(
+            lambda b: jax.tree.map(
+                lambda v: v[None],
+                wops.win_create(b[0], sched, zero_init=zero_init)),
+            ctx.mesh, P("rank"), _win_specs()))
+    win = fn(tensor)
+    _registry[name] = _WindowEntry(
+        window=win, sched=sched,
+        version=np.zeros((ctx.size, max(sched.max_in_degree, 1)), dtype=np.int64))
+    # associated-P channel: one scalar per rank, same mailbox layout
+    pfn = _cached(
+        ("create-p", sched, ctx.mesh, tensor.dtype.name),
+        lambda: _sm(
+            lambda b: jax.tree.map(
+                lambda v: v[None],
+                wops.win_create(b[0], sched, zero_init=True)),
+            ctx.mesh, P("rank"), _win_specs()))
+    _assoc_p[name] = pfn(jnp.ones((ctx.size,), tensor.dtype))
+    return True
+
+
+def win_free(name: Optional[str] = None) -> bool:
+    """Free one window, or all (reference: ``bf.win_free``)."""
+    if name is None:
+        _registry.clear()
+        _assoc_p.clear()
+    else:
+        _registry.pop(name, None)
+        _assoc_p.pop(name, None)
+    return True
+
+
+def _entry(name: str) -> _WindowEntry:
+    if name not in _registry:
+        raise KeyError(f"no window named {name!r}; call win_create first")
+    return _registry[name]
+
+
+# ---------------------------------------------------------------------------
+# Data movement
+# ---------------------------------------------------------------------------
+
+_mask_cache: Dict[str, np.ndarray] = {}
+
+
+def _delivered_mask(sched: CommSchedule, slots: int) -> np.ndarray:
+    """[n, slots] bool: which mailboxes receive something under this schedule."""
+    key = f"{sched.key}:{slots}"
+    mask = _mask_cache.get(key)
+    if mask is None:
+        n = sched.size
+        mask = np.zeros((n, slots), dtype=bool)
+        for r in range(sched.recv_src.shape[0]):
+            for dst in range(n):
+                if sched.recv_src[r, dst] >= 0:
+                    mask[dst, int(sched.recv_slot[r, dst])] = True
+        _mask_cache[key] = mask
+    return mask
+
+
+def _move(kind: str, tensor_or_none, name: str, dst_weights) -> None:
+    ctx = _mesh.get_context()
+    entry = _entry(name)
+    sched = (_dst_schedule(entry.sched, dst_weights)
+             if dst_weights is not None else entry.sched)
+    slots = entry.window.recv.shape[1]
+    if max(sched.max_in_degree, 1) > slots:
+        raise ValueError(
+            f"window {name!r} has {slots} mailboxes but the "
+            f"requested exchange needs {sched.max_in_degree}")
+    op = {"put": wops.win_put, "acc": wops.win_accumulate}.get(kind)
+    if kind == "get":
+        fn = _cached(
+            ("get", sched, ctx.mesh, entry.window.value.shape,
+             entry.window.value.dtype.name),
+            lambda: _sm(
+                lambda w: jax.tree.map(lambda v: v[None], wops.win_get(
+                    jax.tree.map(lambda v: v[0], w), sched, axis="rank")),
+                ctx.mesh, (_win_specs(),), _win_specs()))
+        entry.window = fn(entry.window)
+    else:
+        _mesh_check(tensor_or_none, ctx.size)
+        fn = _cached(
+            (kind, sched, ctx.mesh, tensor_or_none.shape, tensor_or_none.dtype.name),
+            lambda: _sm(
+                lambda w, x: jax.tree.map(lambda v: v[None], op(
+                    jax.tree.map(lambda v: v[0], w), x[0], sched, axis="rank")),
+                ctx.mesh, (_win_specs(), P("rank")), _win_specs()))
+        entry.window = fn(entry.window, tensor_or_none)
+    if _assoc_p_enabled and kind in ("put", "acc"):
+        # gossip the associated-P scalar through the same channel so x/p
+        # de-biasing works (reference: associated-P windows,
+        # mpi_win_ops.cc:65-79,384-427)
+        pwin = _assoc_p[name]
+        pfn = _cached(
+            ("p-" + kind, sched, ctx.mesh, pwin.value.dtype.name),
+            lambda: _sm(
+                lambda w, x: jax.tree.map(lambda v: v[None], op(
+                    jax.tree.map(lambda v: v[0], w), x[0], sched, axis="rank")),
+                ctx.mesh, (_win_specs(), P("rank")), _win_specs()))
+        _assoc_p[name] = pfn(pwin, pwin.value)
+    entry.version += _delivered_mask(sched, slots)
+
+
+def _mesh_check(x, n):
+    if x is None or x.shape[0] != n:
+        raise ValueError(f"expected distributed tensor with leading axis {n}")
+
+
+def win_put(tensor: jax.Array, name: str, *,
+            dst_weights=None, require_mutex: bool = False) -> None:
+    """Deliver ``tensor`` into out-neighbors' mailboxes (reference:
+    ``bf.win_put``).  ``require_mutex`` is accepted for parity; see module
+    docstring."""
+    _move("put", tensor, name, dst_weights)
+
+
+def win_accumulate(tensor: jax.Array, name: str, *,
+                   dst_weights=None, require_mutex: bool = False) -> None:
+    """Add ``tensor`` into out-neighbors' mailboxes (reference:
+    ``bf.win_accumulate``)."""
+    _move("acc", tensor, name, dst_weights)
+
+
+def win_get(name: str) -> None:
+    """Fetch in-neighbors' window tensors into this window's mailboxes
+    (reference: ``bf.win_get``)."""
+    _move("get", None, name, None)
+
+
+# ---------------------------------------------------------------------------
+# Combination
+# ---------------------------------------------------------------------------
+
+def win_update(
+    name: str,
+    self_weight: Optional[Union[float, Sequence[float]]] = None,
+    neighbor_weights: Optional[Sequence[Dict[int, float]]] = None,
+    reset: bool = False,
+    clone: bool = False,
+    require_mutex: bool = False,
+) -> jax.Array:
+    """Combine window tensor + mailboxes, update the window, return the result
+    (reference: ``bf.win_update``, ``mpi_ops.py:1082-1160``).
+
+    Default weights follow the creation schedule (topology weights or
+    uniform); per-rank ``neighbor_weights`` dicts + ``self_weight`` override
+    them.  ``clone`` is accepted for parity (state is functional; the window
+    tensor is always replaced, never aliased).
+    """
+    ctx = _mesh.get_context()
+    entry = _entry(name)
+    sched = entry.sched
+
+    sw_tab = None
+    slot_tab = None
+    if (self_weight is None) != (neighbor_weights is None):
+        raise ValueError(
+            "self_weight and neighbor_weights must be presented at the same time")
+    if self_weight is not None:
+        n = ctx.size
+        sw_tab = (np.full(n, float(self_weight), np.float32)
+                  if np.isscalar(self_weight)
+                  else np.asarray([float(w) for w in self_weight], np.float32))
+        slot_tab = _slot_table_from_weights(sched, neighbor_weights)
+
+    def _build(shape, dtype):
+        return _cached(
+            ("update", sched, ctx.mesh, shape, dtype, reset,
+             None if sw_tab is None else sw_tab.tobytes(),
+             None if slot_tab is None else slot_tab.tobytes()),
+            lambda: _sm(
+                lambda w: jax.tree.map(
+                    lambda v: v[None],
+                    wops.win_update(
+                        jax.tree.map(lambda v: v[0], w), sched, axis="rank",
+                        self_weight=sw_tab, slot_weights=slot_tab, reset=reset)),
+                ctx.mesh, (_win_specs(),), (P("rank"), _win_specs())))
+
+    value, win = _build(entry.window.value.shape,
+                        entry.window.value.dtype.name)(entry.window)
+    entry.window = win
+    if _assoc_p_enabled:
+        pwin = _assoc_p[name]
+        _, _assoc_p[name] = _build(pwin.value.shape, pwin.value.dtype.name)(pwin)
+    if reset:
+        entry.version[:] = 0
+    return value
+
+
+def win_update_then_collect(name: str, require_mutex: bool = True) -> jax.Array:
+    """Sum mailboxes into the window tensor and clear them (reference:
+    ``mpi_ops.py:1064-1080``)."""
+    ctx = _mesh.get_context()
+    entry = _entry(name)
+    sched = entry.sched
+
+    def _build(shape, dtype):
+        return _cached(
+            ("collect", sched, ctx.mesh, shape, dtype),
+            lambda: _sm(
+                lambda w: jax.tree.map(
+                    lambda v: v[None],
+                    wops.win_update_then_collect(
+                        jax.tree.map(lambda v: v[0], w), sched, axis="rank")),
+                ctx.mesh, (_win_specs(),), (P("rank"), _win_specs())))
+
+    value, win = _build(entry.window.value.shape,
+                        entry.window.value.dtype.name)(entry.window)
+    entry.window = win
+    if _assoc_p_enabled:
+        pwin = _assoc_p[name]
+        _, _assoc_p[name] = _build(pwin.value.shape, pwin.value.dtype.name)(pwin)
+    entry.version[:] = 0
+    return value
+
+
+# ---------------------------------------------------------------------------
+# Parity shims: mutex / version / associated-P
+# ---------------------------------------------------------------------------
+
+@contextlib.contextmanager
+def win_mutex(name: str, for_self: bool = False, ranks: Optional[List[int]] = None):
+    """No-op under SPMD (reference: distributed spin-lock windows,
+    ``mpi_controller.cc:1594-1663``).  Delivery points are deterministic in
+    the compiled program, so there is nothing to lock."""
+    yield
+
+
+def get_win_version(name: str) -> np.ndarray:
+    """[n, max_in_degree] count of puts delivered per mailbox since the last
+    reset (reference: version windows, ``mpi_controller.cc:1284-1392``)."""
+    return _entry(name).version.copy()
+
+
+def win_associated_p(name: str) -> jax.Array:
+    """The push-sum associated-P scalar per rank (reference:
+    ``bf.win_associated_p``, ``mpi_ops.py:1479-1503``).
+
+    Only meaningful after :func:`turn_on_win_ops_with_associated_p`: while
+    enabled, every put/accumulate/update gossips the P scalar through the
+    same weighted channel as the window data, so ``value / p`` de-biases
+    directed (column-substochastic) exchanges."""
+    return _assoc_p[name].value
+
+
+def turn_on_win_ops_with_associated_p() -> None:
+    global _assoc_p_enabled
+    _assoc_p_enabled = True
+
+
+def turn_off_win_ops_with_associated_p() -> None:
+    global _assoc_p_enabled
+    _assoc_p_enabled = False

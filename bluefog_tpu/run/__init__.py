@@ -1,0 +1,4 @@
+"""Launcher layer (reference: bluefog/run — bfrun/ibfrun)."""
+from .launcher import main
+
+__all__ = ["main"]

@@ -1,0 +1,145 @@
+"""bfrun-tpu: thin multi-host launcher over jax.distributed.
+
+Counterpart of the reference's ``bfrun`` (``run/run.py``): where bfrun builds
+an ``mpirun`` command line with NIC discovery, SSH checks and env forwarding
+(~900 lines of vendored Horovod driver code), a TPU pod needs none of that —
+every host runs the same script and ``jax.distributed.initialize()`` reads
+the pod metadata (coordinator, process count, local devices) from the
+environment.  This launcher keeps the familiar CLI surface:
+
+    bfrun-tpu -np 4 python train.py            # 4 local processes (CPU/dev)
+    bfrun-tpu --coordinator host0:1234 --num-processes 16 --process-id 3 \
+        python train.py                        # explicit multi-host bootstrap
+    bfrun-tpu python train.py                  # TPU pod: auto-detect
+
+Env forwarding matches bfrun's ``-x``/env behavior: the child inherits the
+environment plus BLUEFOG_* variables are always passed through.
+
+The reference's interactive mode (``ibfrun``, ipyparallel) has no TPU
+counterpart here; for interactive work use a colab-style single-host session
+— the SPMD model makes every rank visible in one process.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import shlex
+import subprocess
+import sys
+from typing import List, Optional
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="bfrun-tpu",
+        description="Launch a bluefog_tpu training script (single or multi host).")
+    p.add_argument("-np", "--num-local-processes", type=int, default=None,
+                   help="spawn N local processes with a virtual device split "
+                        "(testing/CPU; reference: bfrun -np)")
+    p.add_argument("--coordinator", default=None,
+                   help="coordinator address host:port for jax.distributed")
+    p.add_argument("--num-processes", type=int, default=None,
+                   help="total process count for jax.distributed")
+    p.add_argument("--process-id", type=int, default=None,
+                   help="this host's process id (omit on TPU pods: auto)")
+    p.add_argument("--timeline-filename", default=None,
+                   help="enable timeline tracing to this path prefix "
+                        "(sets BLUEFOG_TIMELINE; reference: bfrun flag)")
+    p.add_argument("-x", "--env", action="append", default=[],
+                   help="extra NAME=VALUE env for the child (repeatable)")
+    p.add_argument("command", nargs=argparse.REMAINDER,
+                   help="the training command, e.g. python train.py")
+    return p
+
+
+def _child_env(args) -> dict:
+    env = dict(os.environ)
+    for kv in args.env:
+        if "=" not in kv:
+            raise SystemExit(f"-x expects NAME=VALUE, got {kv!r}")
+        k, v = kv.split("=", 1)
+        env[k] = v
+    if args.timeline_filename:
+        env["BLUEFOG_TIMELINE"] = args.timeline_filename
+    return env
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    if not args.command:
+        build_parser().print_help()
+        return 2
+    cmd = args.command
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+
+    env = _child_env(args)
+
+    if args.num_local_processes:
+        # local multi-process emulation: each process sees a slice of a
+        # virtual CPU device mesh via jax.distributed (testing path; plays
+        # the role of `mpirun -np N` on one machine)
+        n = args.num_local_processes
+        coordinator = args.coordinator or "127.0.0.1:48291"
+        procs = []
+        for pid in range(n):
+            penv = dict(env)
+            penv.update({
+                "JAX_PLATFORMS": env.get("JAX_PLATFORMS", "cpu"),
+                "BLUEFOG_COORDINATOR": coordinator,
+                "BLUEFOG_NUM_PROCESSES": str(n),
+                "BLUEFOG_PROCESS_ID": str(pid),
+            })
+            procs.append(subprocess.Popen(cmd, env=penv))
+        rc = 0
+        for p in procs:
+            rc = rc or p.wait()
+        return rc
+
+    if args.coordinator:
+        if (args.num_processes or 1) > 1 and args.process_id is None:
+            raise SystemExit(
+                "--process-id is required with --coordinator off-pod: "
+                "defaulting every host to process 0 would deadlock the "
+                "coordinator barrier")
+        env.update({
+            "BLUEFOG_COORDINATOR": args.coordinator,
+            "BLUEFOG_NUM_PROCESSES": str(args.num_processes or 1),
+        })
+        if args.process_id is not None:
+            env["BLUEFOG_PROCESS_ID"] = str(args.process_id)
+
+    return subprocess.call(cmd, env=env)
+
+
+def maybe_initialize_distributed() -> bool:
+    """Called by ``bf.init``: bootstrap jax.distributed when launched by
+    bfrun-tpu (BLUEFOG_COORDINATOR) or running on a TPU pod (auto-detect).
+
+    Returns True if jax.distributed was initialized.
+    """
+    import jax
+
+    if jax.distributed.is_initialized():
+        return True
+    coord = os.environ.get("BLUEFOG_COORDINATOR")
+    if coord:
+        jax.distributed.initialize(
+            coordinator_address=coord,
+            num_processes=int(os.environ["BLUEFOG_NUM_PROCESSES"]),
+            process_id=int(os.environ.get("BLUEFOG_PROCESS_ID", "0")),
+        )
+        return True
+    # TPU pods: jax.distributed.initialize() with no args reads the metadata
+    # server; only attempt when the env clearly indicates a multi-host pod.
+    # (Single-host plugins may set TPU_WORKER_HOSTNAMES=localhost — not a pod.)
+    hostnames = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    multi_host = len(hostnames.split(",")) > 1 and hostnames != "localhost"
+    if multi_host or os.environ.get("MEGASCALE_COORDINATOR_ADDRESS"):
+        jax.distributed.initialize()
+        return True
+    return False
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -103,10 +103,16 @@ class CommSchedule:
     recv_slot: np.ndarray            # [R, size] int32
     # scale the SENDER applies before sending in round r (dst-weighting)
     send_scale: np.ndarray           # [R, size] float
+    # weight per in-neighbor slot (sorted-src order; 0 beyond in_degree) —
+    # used by window updates, where received values live in slot buffers
+    slot_weight: np.ndarray          # [max_in_degree, size] float
     # per-device self weight
     self_weight: np.ndarray          # [size] float
     in_degree: np.ndarray            # [size] int32
     out_degree: np.ndarray           # [size] int32
+    # sorted in-neighbors per device: the canonical mailbox-slot layout
+    # (slot k of device d belongs to in_neighbors[d][k])
+    in_neighbors: Tuple[Tuple[int, ...], ...] = ()
     uses_dst_weighting: bool = False
     key: str = field(default="")     # content hash for jit-cache identity
 
@@ -115,7 +121,7 @@ class CommSchedule:
             h = hashlib.sha1()
             h.update(repr(self.rounds).encode())
             for arr in (self.recv_weight, self.recv_src, self.recv_slot,
-                        self.send_scale, self.self_weight):
+                        self.send_scale, self.slot_weight, self.self_weight):
                 h.update(np.ascontiguousarray(arr).tobytes())
             object.__setattr__(self, "key", h.hexdigest())
 
@@ -169,6 +175,12 @@ def _build_tables(
             if send_scales is not None:
                 send_scale[r, src] = send_scales.get((src, dst), 1.0)
 
+    max_in = max(int(in_degree.max(initial=0)), 1)
+    slot_weight = np.zeros((max_in, size), dtype=np.float32)
+    for dst in range(size):
+        for src, slot in slot_of[dst].items():
+            slot_weight[slot, dst] = edge_weights[(src, dst)]
+
     return CommSchedule(
         size=size,
         rounds=tuple(tuple(re) for re in rounds),
@@ -176,9 +188,11 @@ def _build_tables(
         recv_src=recv_src,
         recv_slot=recv_slot,
         send_scale=send_scale,
+        slot_weight=slot_weight,
         self_weight=np.asarray(self_weight, dtype=np.float32),
         in_degree=in_degree,
         out_degree=out_degree,
+        in_neighbors=tuple(tuple(sorted(srcs)) for srcs in in_neighbors),
         uses_dst_weighting=send_scales is not None,
     )
 

@@ -1,0 +1,17 @@
+"""Utilities: timeline tracing, parameter sync helpers, env config."""
+from .timeline import (
+    timeline_start_activity, timeline_end_activity, timeline_context,
+    start_timeline, stop_timeline,
+)
+from .utility import (
+    broadcast_parameters, allreduce_parameters, broadcast_optimizer_state,
+)
+from .config import env_flag, env_int, env_float
+
+__all__ = [
+    "timeline_start_activity", "timeline_end_activity", "timeline_context",
+    "start_timeline", "stop_timeline",
+    "broadcast_parameters", "allreduce_parameters",
+    "broadcast_optimizer_state",
+    "env_flag", "env_int", "env_float",
+]

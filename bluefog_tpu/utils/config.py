@@ -1,0 +1,47 @@
+"""Env-var config helpers (reference: docs/env_variable.rst).
+
+The reference configures everything through BLUEFOG_* environment variables
+(fusion threshold, cycle time, log level...).  Most have no TPU equivalent
+(no fusion buffers, no cycle loop); the ones that survive:
+
+* ``BLUEFOG_TIMELINE``       — timeline output prefix (utils.timeline)
+* ``BLUEFOG_LOG_LEVEL``      — python logging level for the "bluefog_tpu" logger
+* ``BLUEFOG_NODES_PER_MACHINE`` — virtual machine split for hierarchical ops
+  (read by bf.init when nodes_per_machine is not passed explicitly)
+"""
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+logger = logging.getLogger("bluefog_tpu")
+
+
+def env_flag(name: str, default: bool = False) -> bool:
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    return v.strip().lower() in ("1", "true", "yes", "on")
+
+
+def env_int(name: str, default: Optional[int] = None) -> Optional[int]:
+    v = os.environ.get(name)
+    return default if v is None else int(v)
+
+
+def env_float(name: str, default: Optional[float] = None) -> Optional[float]:
+    v = os.environ.get(name)
+    return default if v is None else float(v)
+
+
+def setup_logging() -> None:
+    level = os.environ.get("BLUEFOG_LOG_LEVEL", "warning").upper()
+    if level in ("TRACE",):
+        level = "DEBUG"
+    logger.setLevel(getattr(logging, level, logging.WARNING))
+    if not logger.handlers:
+        h = logging.StreamHandler()
+        h.setFormatter(logging.Formatter(
+            "[%(asctime)s %(levelname)s bluefog_tpu] %(message)s"))
+        logger.addHandler(h)

@@ -1,0 +1,128 @@
+"""Timeline tracing: Chrome-trace activities + jax.profiler integration.
+
+Counterpart of the reference's timeline subsystem (``common/timeline.{h,cc}``,
+``basics.py:456-546``): the reference runs a dedicated writer thread draining
+a lock-free queue of activity events into Chrome-tracing JSON.  Here the
+device-side story is ``jax.profiler`` (XLA's own tracing captures every
+collective, fusion and transfer — strictly more detail than the reference's
+COMMUNICATE/NEGOTIATE spans), and this module adds the reference's
+*host-side* activity API on top:
+
+* ``start_timeline(path)`` / ``stop_timeline()`` — like
+  ``bf.timeline_start_activity``'s file contract: writes
+  ``<path>.trace.json.gz`` (jax.profiler trace, viewable in Perfetto) plus
+  ``<path>.activities.json`` (Chrome-tracing JSON of host activity spans).
+* ``timeline_start_activity(name, category)`` / ``timeline_end_activity`` /
+  ``timeline_context`` — manual spans (reference: ``basics.py:456-546``),
+  also forwarded to ``jax.profiler.TraceAnnotation`` so they appear inside
+  the device trace.
+
+The environment variable ``BLUEFOG_TIMELINE`` (reference:
+``docs/env_variable.rst``) enables tracing at init: set it to the output
+path prefix.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+import jax
+
+_lock = threading.Lock()
+_events: List[dict] = []
+_open_spans: Dict[str, list] = {}
+_path_prefix: Optional[str] = None
+_profiler_active = False
+
+
+def start_timeline(path_prefix: str, with_device_trace: bool = True) -> bool:
+    """Begin collecting a timeline (reference: timeline file per rank,
+    ``operations.cc:464-473``; here one file per process)."""
+    global _path_prefix, _profiler_active
+    with _lock:
+        if _path_prefix is not None:
+            return False
+        _path_prefix = path_prefix
+        _events.clear()
+        _open_spans.clear()
+    if with_device_trace:
+        try:
+            jax.profiler.start_trace(path_prefix + ".device_trace")
+            _profiler_active = True
+        except Exception:          # profiler may be unavailable (e.g. double start)
+            _profiler_active = False
+    return True
+
+
+def stop_timeline() -> Optional[str]:
+    """Flush the activity JSON (+ device trace) and return the activities path."""
+    global _path_prefix, _profiler_active
+    if _profiler_active:
+        try:
+            jax.profiler.stop_trace()
+        finally:
+            _profiler_active = False
+    with _lock:
+        if _path_prefix is None:
+            return None
+        out = _path_prefix + ".activities.json"
+        os.makedirs(os.path.dirname(out) or ".", exist_ok=True)
+        with open(out, "w") as f:
+            json.dump({"traceEvents": _events, "displayTimeUnit": "ms"}, f)
+        _path_prefix = None
+        return out
+
+
+def _now_us() -> float:
+    return time.perf_counter() * 1e6
+
+
+def timeline_start_activity(tensor_name: str, activity_name: str = "ACTIVITY") -> bool:
+    """Open a named span (reference: ``bf.timeline_start_activity``)."""
+    if _path_prefix is None:
+        return False
+    ann = jax.profiler.TraceAnnotation(f"{tensor_name}::{activity_name}")
+    ann.__enter__()
+    with _lock:
+        _open_spans.setdefault(tensor_name, []).append(
+            (activity_name, _now_us(), ann))
+    return True
+
+
+def timeline_end_activity(tensor_name: str) -> bool:
+    """Close the innermost open span for ``tensor_name``."""
+    if _path_prefix is None:
+        return False
+    with _lock:
+        spans = _open_spans.get(tensor_name)
+        if not spans:
+            return False
+        activity, t0, ann = spans.pop()
+        _events.append({
+            "name": activity, "cat": tensor_name, "ph": "X",
+            "ts": t0, "dur": _now_us() - t0,
+            "pid": os.getpid(), "tid": threading.get_ident() % 1_000_000,
+        })
+    ann.__exit__(None, None, None)
+    return True
+
+
+@contextlib.contextmanager
+def timeline_context(tensor_name: str, activity_name: str = "ACTIVITY"):
+    """Span context manager (reference: ``bf.timeline_context``)."""
+    timeline_start_activity(tensor_name, activity_name)
+    try:
+        yield
+    finally:
+        timeline_end_activity(tensor_name)
+
+
+def maybe_start_from_env() -> None:
+    """Honor BLUEFOG_TIMELINE at init (reference: env_variable.rst)."""
+    prefix = os.environ.get("BLUEFOG_TIMELINE")
+    if prefix:
+        start_timeline(prefix)

@@ -1,0 +1,46 @@
+"""Parameter/state sync helpers (reference: bluefog/torch/utility.py).
+
+``broadcast_parameters`` / ``broadcast_optimizer_state`` are the state-sync
+primitives used at (re)start; ``allreduce_parameters`` averages in place.
+All operate on distributed pytrees (leading rank axis).
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..parallel import context as _mesh
+from .. import ops
+
+
+def _lift(op):
+    def fn(tree):
+        ctx = _mesh.get_context()
+        f = jax.jit(jax.shard_map(
+            lambda t: jax.tree.map(lambda x: op(x[0])[None], t),
+            mesh=ctx.mesh, in_specs=P("rank"), out_specs=P("rank")))
+        return f(tree)
+    return fn
+
+
+def broadcast_parameters(params: Any, root_rank: int = 0) -> Any:
+    """Every rank's slice becomes root's (reference: ``utility.py:26-56``)."""
+    return _lift(lambda x: ops.broadcast(x, root_rank))(params)
+
+
+def allreduce_parameters(params: Any) -> Any:
+    """Average all ranks' slices in place (reference: ``utility.py:58-87``)."""
+    return _lift(lambda x: ops.allreduce(x, average=True))(params)
+
+
+def broadcast_optimizer_state(opt_state: Any, root_rank: int = 0) -> Any:
+    """Sync optimizer state from root (reference: ``utility.py:89-216``).
+
+    The reference must tensor-wrap scalars and walk the torch state dict;
+    optax states are already pytrees of arrays, so this is broadcast over
+    every leaf (integer leaves included — exact copy, no averaging).
+    """
+    return broadcast_parameters(opt_state, root_rank)

@@ -18,6 +18,12 @@ import jax  # noqa: E402
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
+# The axon TPU plugin (when present) force-registers itself by setting
+# jax_platforms="axon,cpu" at interpreter boot, overriding JAX_PLATFORMS from
+# the environment; creating its client would dial the TPU tunnel from inside
+# the test suite.  Override back: tests are hermetic on the host backend.
+jax.config.update("jax_platforms", "cpu")
+
 
 @pytest.fixture(scope="session")
 def cpu_devices():

@@ -1,0 +1,145 @@
+"""Native (C++) runtime components, bound via ctypes.
+
+The reference's runtime core is C++ (SURVEY.md §2.1); the TPU compute path
+here is XLA, but the host-side runtime pieces that benefit from native code
+are implemented in C++ as well:
+
+* ``timeline.cc`` — chrome-trace writer with a ring buffer + flush thread
+  (reference: ``common/timeline.{h,cc}``'s spsc queue + TimelineWriter).
+* ``schedule.cc`` — edge -> ppermute-round coloring for large topologies
+  (reference: graph-communicator construction, ``mpi_context.cc:412-430``).
+
+The shared library is built on demand with ``g++`` (no pip/pybind needed —
+plain ``extern "C"`` + ctypes) and cached next to the sources.  Every entry
+point has a pure-Python fallback, so the package works without a toolchain.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import List, Optional, Sequence, Tuple
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_LIB_PATH = os.path.join(_HERE, "libbft_native.so")
+_SOURCES = ("timeline.cc", "schedule.cc")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_failed = False
+
+
+def _build() -> bool:
+    srcs = [os.path.join(_HERE, s) for s in _SOURCES]
+    cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+           "-o", _LIB_PATH] + srcs + ["-lpthread"]
+    try:
+        r = subprocess.run(cmd, capture_output=True, timeout=120)
+        return r.returncode == 0
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """Load (building if needed) the native library; None when unavailable."""
+    global _lib, _build_failed
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _build_failed:
+            return None
+        stale = (
+            not os.path.exists(_LIB_PATH)
+            or any(
+                os.path.getmtime(os.path.join(_HERE, s)) > os.path.getmtime(_LIB_PATH)
+                for s in _SOURCES
+            )
+        )
+        if stale and not _build():
+            _build_failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError:
+            _build_failed = True
+            return None
+        lib.bft_timeline_start.argtypes = [ctypes.c_char_p]
+        lib.bft_timeline_start.restype = ctypes.c_int
+        lib.bft_timeline_record.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int32, ctypes.c_int32]
+        lib.bft_timeline_record.restype = ctypes.c_int
+        lib.bft_timeline_stop.argtypes = []
+        lib.bft_timeline_stop.restype = ctypes.c_int64
+        lib.bft_timeline_dropped.argtypes = []
+        lib.bft_timeline_dropped.restype = ctypes.c_int64
+        lib.bft_color_edges.argtypes = [
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int64, ctypes.c_int32, ctypes.POINTER(ctypes.c_int32)]
+        lib.bft_color_edges.restype = ctypes.c_int32
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+# ---------------------------------------------------------------------------
+# schedule: native edge coloring
+# ---------------------------------------------------------------------------
+
+def color_edges_native(
+    edges: Sequence[Tuple[int, int]], size: int,
+) -> Optional[List[List[Tuple[int, int]]]]:
+    """Native edge->round partitioning; None when the library is unavailable.
+
+    Output contract matches ``schedule.color_edges`` (same greedy order).
+    """
+    lib = load()
+    if lib is None:
+        return None
+    import numpy as np
+
+    dedup = sorted(set((int(s), int(d)) for s, d in edges))
+    n = len(dedup)
+    srcs = np.asarray([e[0] for e in dedup], dtype=np.int32)
+    dsts = np.asarray([e[1] for e in dedup], dtype=np.int32)
+    out = np.empty(n, dtype=np.int32)
+    n_rounds = lib.bft_color_edges(
+        srcs.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        dsts.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        n, size, out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+    if n_rounds < 0:
+        raise ValueError("invalid edge set (self-loop or rank out of range)")
+    rounds: List[List[Tuple[int, int]]] = [[] for _ in range(n_rounds)]
+    # rebuild each round in the colorer's processing order
+    order = sorted(range(n),
+                   key=lambda i: ((dedup[i][1] - dedup[i][0]) % size, dedup[i][0]))
+    for i in order:
+        rounds[int(out[i])].append(dedup[i])
+    return rounds
+
+
+# ---------------------------------------------------------------------------
+# timeline: native writer
+# ---------------------------------------------------------------------------
+
+def timeline_start(path: str) -> bool:
+    lib = load()
+    return bool(lib and lib.bft_timeline_start(path.encode()))
+
+
+def timeline_record(name: str, cat: str, ph: str, ts_us: int,
+                    dur_us: int = 0, pid: int = 0, tid: int = 0) -> bool:
+    lib = load()
+    return bool(lib and lib.bft_timeline_record(
+        name.encode(), cat.encode(), ph.encode(), int(ts_us), int(dur_us),
+        int(pid), int(tid)))
+
+
+def timeline_stop() -> int:
+    """Stop + flush; returns dropped-event count (-1 if not running)."""
+    lib = load()
+    return int(lib.bft_timeline_stop()) if lib else -1

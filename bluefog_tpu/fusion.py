@@ -1,0 +1,82 @@
+"""Tensor fusion: bucket pytrees into flat buffers for collective ops.
+
+TPU-native counterpart of the reference's fusion-buffer machinery
+(``FusionBufferManager``, ``tensor_queue.h:75-124``; fused neighbor ops,
+``mpi_controller.cc:519-745``; response fusion in the coordinator,
+``operations.cc:943-1020``).  The reference copies up to 8 MB of tensors into
+a persistent fusion buffer so one MPI/NCCL call carries many tensors; the
+motivation — amortize per-message latency over the edge set — applies equally
+to ICI collectives: a gossip step over a pytree with L leaves otherwise lowers
+to ``L x num_rounds`` ``ppermute`` ops, each with its own latency and its own
+barrier against XLA's latency-hiding scheduler.  Fusing the pytree into one
+flat buffer per dtype makes it ``num_rounds`` permutes total, independent of
+model depth.
+
+Unlike the reference there is no threshold or cycle timer: the bucketing is
+static (shapes are known at trace time), costs two reshapes that XLA folds
+into the surrounding program, and fuses the *whole* tree (XLA handles
+multi-hundred-MB permutes fine; no 8 MB ceiling).
+
+Used by the optimizer strategies via ``fuse=True`` (the default for
+communicators built from ``communication_type`` strings).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["fuse_tree", "FusedTree", "fused_leaf_op"]
+
+
+class FusedTree:
+    """Flat per-dtype buffers + the recipe to rebuild the original tree."""
+
+    def __init__(self, buffers: List[jax.Array], treedef, groups, shapes):
+        self.buffers = buffers          # one 1-D array per dtype group
+        self._treedef = treedef
+        self._groups = groups           # per group: list of leaf indices
+        self._shapes = shapes           # per leaf: original shape
+
+    def unfuse(self) -> Any:
+        leaves: List[Any] = [None] * len(self._shapes)
+        for buf, idxs in zip(self.buffers, self._groups):
+            off = 0
+            for i in idxs:
+                shape = self._shapes[i]
+                n = int(np.prod(shape)) if shape else 1
+                leaves[i] = jax.lax.dynamic_slice_in_dim(
+                    buf, off, n, axis=0).reshape(shape)
+                off += n
+        return jax.tree.unflatten(self._treedef, leaves)
+
+
+def fuse_tree(tree: Any) -> FusedTree:
+    """Flatten a pytree into one 1-D buffer per dtype (stable leaf order)."""
+    leaves, treedef = jax.tree.flatten(tree)
+    by_dtype = {}
+    for i, leaf in enumerate(leaves):
+        by_dtype.setdefault(jnp.asarray(leaf).dtype, []).append(i)
+    groups = [idxs for _, idxs in sorted(by_dtype.items(), key=lambda kv: str(kv[0]))]
+    buffers = [
+        jnp.concatenate([jnp.ravel(leaves[i]) for i in idxs])
+        for idxs in groups
+    ]
+    shapes = [jnp.shape(leaf) for leaf in leaves]
+    return FusedTree(buffers, treedef, groups, shapes)
+
+
+def fused_leaf_op(op: Callable[[jax.Array], jax.Array]) -> Callable[[Any], Any]:
+    """Lift a per-array collective to a whole-pytree op via fusion.
+
+    ``op`` must be shape-preserving (neighbor_allreduce, pmean, ...).  The
+    returned function fuses the tree, applies ``op`` once per dtype buffer,
+    and unfuses — turning L per-leaf collectives into one per dtype.
+    """
+    def tree_op(tree: Any) -> Any:
+        fused = fuse_tree(tree)
+        fused.buffers = [op(buf) for buf in fused.buffers]
+        return fused.unfuse()
+    return tree_op

@@ -43,7 +43,7 @@ import optax
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from . import ops
+from . import fusion, ops
 from .ops import windows as wops
 from .parallel import context as _mesh
 from .schedule import CommSchedule
@@ -61,12 +61,15 @@ def neighbor_communicator(
     schedules: Optional[Sequence[CommSchedule]] = None,
     *,
     axis: Axis = "rank",
+    fuse: bool = True,
 ) -> Communicator:
-    """Per-leaf neighbor averaging; dynamic when ``schedules`` is given.
+    """Neighbor averaging of a params pytree; dynamic when ``schedules``.
 
     Dynamic topologies compile to a ``lax.switch`` over the period's branches
     (the reference instead re-negotiates per-iteration send/recv lists,
     ``optimizers.py`` + ``examples/pytorch_benchmark.py:182-208``).
+    ``fuse`` gossips one flat buffer per dtype instead of one permute chain
+    per leaf (reference fusion buffers, SURVEY.md §2.4).
     """
     if (schedule is None) == (schedules is None):
         raise ValueError("pass exactly one of schedule / schedules")
@@ -80,6 +83,8 @@ def neighbor_communicator(
                 for s in schedules
             ]
             return lax.switch(step % len(schedules), branches, x)
+        if fuse:
+            return fusion.fused_leaf_op(leaf)(params)
         return jax.tree.map(leaf, params)
 
     return comm
@@ -91,6 +96,7 @@ def hierarchical_communicator(
     *,
     machine_axis: Axis = "machine",
     local_axis: Axis = "local",
+    fuse: bool = True,
 ) -> Communicator:
     """Machine-level neighbor averaging on the 2-D mesh (reference:
     ``DistributedHierarchicalNeighborAllreduceOptimizer``)."""
@@ -107,6 +113,8 @@ def hierarchical_communicator(
                 for s in machine_schedules
             ]
             return lax.switch(step % len(machine_schedules), branches, xm)
+        if fuse:
+            return fusion.fused_leaf_op(leaf)(params)
         return jax.tree.map(leaf, params)
 
     return comm
@@ -175,6 +183,7 @@ def _map_windows(fn, windows, *rest):
 
 def gradient_allreduce(
     opt: optax.GradientTransformation, *, axis: Axis = "rank",
+    fuse: bool = True,
 ) -> DecentralizedOptimizer:
     """Horovod-style synchronous data parallelism (reference:
     ``DistributedGradientAllreduceOptimizer``, ``optimizers.py:166-294``)."""
@@ -182,7 +191,11 @@ def gradient_allreduce(
         return DecentralizedState(jnp.zeros((), jnp.int32), opt.init(params))
 
     def update(grads, state, params):
-        grads = jax.tree.map(lambda g: lax.pmean(g, axis), grads)
+        reduce_ = lambda g: lax.pmean(g, axis)
+        if fuse:
+            grads = fusion.fused_leaf_op(reduce_)(grads)
+        else:
+            grads = jax.tree.map(reduce_, grads)
         new_params, opt_state = _apply(opt, grads, state.opt_state, params)
         return new_params, DecentralizedState(state.step + 1, opt_state)
 

@@ -32,23 +32,31 @@ from typing import Dict, List, Optional
 
 import jax
 
+from .. import _native
+
 _lock = threading.Lock()
 _events: List[dict] = []
 _open_spans: Dict[str, list] = {}
 _path_prefix: Optional[str] = None
 _profiler_active = False
+_native_active = False
 
 
 def start_timeline(path_prefix: str, with_device_trace: bool = True) -> bool:
     """Begin collecting a timeline (reference: timeline file per rank,
     ``operations.cc:464-473``; here one file per process)."""
-    global _path_prefix, _profiler_active
+    global _path_prefix, _profiler_active, _native_active
     with _lock:
         if _path_prefix is not None:
             return False
         _path_prefix = path_prefix
         _events.clear()
         _open_spans.clear()
+    # Prefer the native writer (C++ ring buffer + flush thread — the
+    # reference's TimelineWriter design); fall back to the in-process list.
+    out = path_prefix + ".activities.json"
+    os.makedirs(os.path.dirname(out) or ".", exist_ok=True)
+    _native_active = _native.timeline_start(out)
     if with_device_trace:
         try:
             jax.profiler.start_trace(path_prefix + ".device_trace")
@@ -60,7 +68,7 @@ def start_timeline(path_prefix: str, with_device_trace: bool = True) -> bool:
 
 def stop_timeline() -> Optional[str]:
     """Flush the activity JSON (+ device trace) and return the activities path."""
-    global _path_prefix, _profiler_active
+    global _path_prefix, _profiler_active, _native_active
     if _profiler_active:
         try:
             jax.profiler.stop_trace()
@@ -70,9 +78,13 @@ def stop_timeline() -> Optional[str]:
         if _path_prefix is None:
             return None
         out = _path_prefix + ".activities.json"
-        os.makedirs(os.path.dirname(out) or ".", exist_ok=True)
-        with open(out, "w") as f:
-            json.dump({"traceEvents": _events, "displayTimeUnit": "ms"}, f)
+        if _native_active:
+            _native.timeline_stop()
+            _native_active = False
+        else:
+            os.makedirs(os.path.dirname(out) or ".", exist_ok=True)
+            with open(out, "w") as f:
+                json.dump({"traceEvents": _events, "displayTimeUnit": "ms"}, f)
         _path_prefix = None
         return out
 
@@ -102,11 +114,17 @@ def timeline_end_activity(tensor_name: str) -> bool:
         if not spans:
             return False
         activity, t0, ann = spans.pop()
-        _events.append({
-            "name": activity, "cat": tensor_name, "ph": "X",
-            "ts": t0, "dur": _now_us() - t0,
-            "pid": os.getpid(), "tid": threading.get_ident() % 1_000_000,
-        })
+        pid = os.getpid()
+        tid = threading.get_ident() % 1_000_000
+        if _native_active:
+            _native.timeline_record(
+                activity, tensor_name, "X", int(t0), int(_now_us() - t0),
+                pid, tid)
+        else:
+            _events.append({
+                "name": activity, "cat": tensor_name, "ph": "X",
+                "ts": t0, "dur": _now_us() - t0, "pid": pid, "tid": tid,
+            })
     ann.__exit__(None, None, None)
     return True
 

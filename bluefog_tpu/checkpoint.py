@@ -1,0 +1,98 @@
+"""Checkpoint/resume for distributed training state.
+
+The reference ships no checkpoint subsystem (SURVEY.md §5): its examples save
+with plain torch, and ``broadcast_parameters`` / ``broadcast_optimizer_state``
+re-sync state after a restart.  The TPU-native equivalent uses orbax (the
+JAX-ecosystem checkpointer) over the distributed pytrees this framework
+trains: every leaf carries the leading rank axis, so one checkpoint captures
+every rank's (generally *different*, pre-consensus) parameters — restoring
+reproduces the decentralized state exactly, not just a consensus average.
+
+``save``/``restore`` round-trip ``(dist_params, dist_state, step)``;
+``restore_latest`` scans a directory of step-numbered checkpoints.  After
+restoring on a fresh process layout, ``utils.broadcast_parameters`` (the
+reference's restart primitive) can re-seed ranks from rank 0 when the
+topology or world size changed.
+"""
+from __future__ import annotations
+
+import os
+import re
+from typing import Any, Optional, Tuple
+
+import jax
+
+__all__ = ["save", "restore", "restore_latest", "latest_step"]
+
+_STEP_DIR = re.compile(r"^step_(\d+)$")
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+    return ocp.PyTreeCheckpointer()
+
+
+def save(directory: str, state: Any, step: int, *, keep: Optional[int] = None) -> str:
+    """Write ``state`` (any pytree of arrays) as ``<directory>/step_<step>``.
+
+    ``keep`` prunes to the newest N step directories (None = keep all).
+    Returns the checkpoint path.
+    """
+    directory = os.path.abspath(directory)
+    os.makedirs(directory, exist_ok=True)
+    path = os.path.join(directory, f"step_{int(step)}")
+    # block so the snapshot is consistent even mid-training-loop
+    state = jax.block_until_ready(state)
+    _checkpointer().save(path, state, force=True)
+    if keep is not None:
+        steps = sorted(all_steps(directory))
+        for s in steps[:-keep]:
+            _rmtree(os.path.join(directory, f"step_{s}"))
+    return path
+
+
+def restore(path: str, template: Optional[Any] = None) -> Any:
+    """Load a checkpoint; ``template`` (matching pytree of ShapeDtypeStruct or
+    arrays) restores with the original structure/dtypes when given."""
+    ckpt = _checkpointer()
+    if template is not None:
+        import orbax.checkpoint as ocp
+        template = jax.tree.map(
+            lambda x: ocp.utils.to_shape_dtype_struct(x)
+            if hasattr(ocp.utils, "to_shape_dtype_struct") else x, template)
+        try:
+            return ckpt.restore(path, item=template)
+        except TypeError:
+            return ckpt.restore(path)
+    return ckpt.restore(path)
+
+
+def all_steps(directory: str):
+    if not os.path.isdir(directory):
+        return []
+    out = []
+    for name in os.listdir(directory):
+        m = _STEP_DIR.match(name)
+        if m:
+            out.append(int(m.group(1)))
+    return sorted(out)
+
+
+def latest_step(directory: str) -> Optional[int]:
+    steps = all_steps(directory)
+    return steps[-1] if steps else None
+
+
+def restore_latest(
+    directory: str, template: Optional[Any] = None,
+) -> Tuple[Optional[Any], Optional[int]]:
+    """Load the newest checkpoint in ``directory``; ``(None, None)`` if empty."""
+    step = latest_step(directory)
+    if step is None:
+        return None, None
+    return restore(os.path.join(directory, f"step_{step}"), template), step
+
+
+def _rmtree(path: str) -> None:
+    import shutil
+    shutil.rmtree(path, ignore_errors=True)

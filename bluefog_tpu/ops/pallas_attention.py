@@ -1,0 +1,136 @@
+"""Pallas TPU kernel: blockwise attention partials for ring attention.
+
+The hot inner step of :func:`bluefog_tpu.ops.ring_attention` is, per K/V
+block, ``s = q k^T; online-softmax fold; o += p v``.  Lowered naively the
+``[Tq, Tk]`` score matrix round-trips through HBM between the einsums; this
+kernel computes one block's *attention partial* entirely in VMEM — both
+matmuls hit the MXU, the scores never leave the chip:
+
+    m_blk = rowmax(s),  p = exp(s - m_blk),  l_blk = rowsum(p),  o_blk = p v
+
+The ring scan then merges partials with the standard flash-attention
+recurrence (merge_partials), which is exactly the fold ring_attention's pure
+-jnp path performs.  On non-TPU backends the kernel runs in interpreter mode
+(slow but correct), so the same code path is testable on the CPU virtual
+mesh.
+
+Reference anchor: the reference has no attention kernels (it predates
+long-context training, SURVEY.md §5); this is the TPU-native capability its
+ring p2p schedules point toward.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30  # large-negative stand-in: keeps exp() exact zeros without nan
+
+
+def _partial_kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref,
+                    o_ref, l_ref, m_ref, *, causal: bool, scale: float):
+    q = q_ref[0].astype(jnp.float32) * scale          # [Tq, D]
+    k = k_ref[0].astype(jnp.float32)                  # [Tk, D]
+    v = v_ref[0].astype(jnp.float32)                  # [Tk, D]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)           # [Tq, Tk]
+    if causal:
+        tq, tk = s.shape
+        q_pos = qoff_ref[0] + jax.lax.broadcasted_iota(jnp.int32, (tq, tk), 0)
+        k_pos = koff_ref[0] + jax.lax.broadcasted_iota(jnp.int32, (tq, tk), 1)
+        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)            # [Tq, 1]
+    safe_m = jnp.where(m <= NEG_INF / 2, 0.0, m)
+    p = jnp.exp(s - safe_m)
+    p = jnp.where(s <= NEG_INF / 2, 0.0, p)
+    l = jnp.sum(p, axis=-1, keepdims=True)            # [Tq, 1]
+    o = jax.lax.dot_general(
+        p, v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)           # [Tq, D]
+    o_ref[0] = o
+    l_ref[0] = l
+    m_ref[0] = jnp.where(m <= NEG_INF / 2, -jnp.inf, m)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("causal", "scale", "interpret"))
+def attention_block_partial(
+    q: jax.Array,                  # [B, Tq, H, D]
+    k: jax.Array,                  # [B, Tk, H, D]
+    v: jax.Array,                  # [B, Tk, H, D]
+    q_offset: jax.Array,           # [] int32 — global position of q[0]
+    k_offset: jax.Array,           # [] int32
+    *,
+    causal: bool = False,
+    scale: float = 1.0,
+    interpret: Optional[bool] = None,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One K/V block's flash-attention partial, fully in VMEM.
+
+    Returns ``(o_blk [B,Tq,H,D] f32, l_blk [B,Tq,H] f32, m_blk [B,Tq,H] f32)``
+    relative to the block max ``m_blk`` (rows with no valid key get
+    ``m = -inf, l = 0, o = 0``).
+    """
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    # [B, Tq, H, D] -> [B*H, Tq, D]: one grid step per (batch, head)
+    qr = q.transpose(0, 2, 1, 3).reshape(B * H, Tq, D)
+    kr = k.transpose(0, 2, 1, 3).reshape(B * H, Tk, D)
+    vr = v.transpose(0, 2, 1, 3).reshape(B * H, Tk, D)
+
+    kernel = functools.partial(_partial_kernel, causal=causal, scale=scale)
+    # under shard_map the outputs vary over the same mesh axes as the inputs
+    vma = getattr(jax.typeof(qr), "vma", frozenset()) or frozenset()
+    grid = (B * H,)
+    data_spec = lambda t, d: pl.BlockSpec((1, t, d), lambda i: (i, 0, 0))
+    o, l, m = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),   # scalar offsets
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            data_spec(Tq, D),
+            data_spec(Tk, D),
+            data_spec(Tk, D),
+        ],
+        out_specs=[
+            data_spec(Tq, D),
+            data_spec(Tq, 1),
+            data_spec(Tq, 1),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, Tq, D), jnp.float32, vma=vma),
+            jax.ShapeDtypeStruct((B * H, Tq, 1), jnp.float32, vma=vma),
+            jax.ShapeDtypeStruct((B * H, Tq, 1), jnp.float32, vma=vma),
+        ],
+        interpret=interpret,
+    )(jnp.reshape(q_offset.astype(jnp.int32), (1,)),
+      jnp.reshape(k_offset.astype(jnp.int32), (1,)),
+      qr, kr, vr)
+
+    o = o.reshape(B, H, Tq, D).transpose(0, 2, 1, 3)
+    l = l.reshape(B, H, Tq).transpose(0, 2, 1)
+    m = m.reshape(B, H, Tq).transpose(0, 2, 1)
+    return o, l, m
+
+
+def merge_partials(carry, partial):
+    """Fold one block partial into the running (o, l, m) flash state."""
+    o, l, m = carry
+    o_b, l_b, m_b = partial
+    m_new = jnp.maximum(m, m_b)
+    safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+    c_old = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - safe))
+    c_new = jnp.where(jnp.isneginf(m_b), 0.0, jnp.exp(m_b - safe))
+    l = l * c_old + l_b * c_new
+    o = o * c_old[..., None] + o_b * c_new[..., None]
+    return o, l, m_new

@@ -64,6 +64,7 @@ def ring_attention(
     axis: Axis = "rank",
     causal: bool = False,
     scale: Optional[float] = None,
+    use_pallas: bool = False,
 ) -> jax.Array:
     """Exact attention over a sequence sharded along ``axis``.
 
@@ -72,6 +73,10 @@ def ring_attention(
     each step contributes one block of scores folded in with the online
     (flash-style) softmax, so memory stays O(block²) while the sequence length
     scales with the number of devices.  Returns this device's output block.
+
+    ``use_pallas`` computes each block's partial with the VMEM flash kernel
+    (:mod:`bluefog_tpu.ops.pallas_attention`) — scores never touch HBM; on
+    non-TPU backends the kernel interprets (use for tests only).
     """
     if q.ndim != 4:
         raise ValueError("expected [batch, block_len, heads, head_dim]")
@@ -81,6 +86,29 @@ def ring_attention(
     if scale is None:
         scale = 1.0 / np.sqrt(d)
     blk_q, blk_k = q.shape[1], k.shape[1]
+
+    if use_pallas:
+        from . import pallas_attention as pa
+        perm_p = _ring_perm(n, 1)
+        o0 = lax.pcast(jnp.zeros(q.shape, jnp.float32), axis, to='varying')
+        l0 = lax.pcast(jnp.zeros(q.shape[:3], jnp.float32), axis, to='varying')
+        m0 = lax.pcast(
+            jnp.full(q.shape[:3], -jnp.inf, jnp.float32), axis, to='varying')
+
+        def pstep(carry, t):
+            o, l, m, kt, vt = carry
+            src = (idx - t) % n
+            part = pa.attention_block_partial(
+                q, kt, vt, idx * blk_q, src * blk_k,
+                causal=causal, scale=scale)
+            o, l, m = pa.merge_partials((o, l, m), part)
+            kt = lax.ppermute(kt, axis, perm=perm_p)
+            vt = lax.ppermute(vt, axis, perm=perm_p)
+            return (o, l, m, kt, vt), None
+
+        (o, l, _, _, _), _ = lax.scan(pstep, (o0, l0, m0, k, v), jnp.arange(n))
+        l = jnp.where(l == 0.0, 1.0, l)
+        return (o / l[..., None]).astype(q.dtype)
 
     qf = q.astype(jnp.float32) * scale
     perm = _ring_perm(n, 1)

@@ -1,0 +1,86 @@
+"""Checkpoint/resume: save -> restore reproduces the decentralized state."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import bluefog_tpu as bf
+from bluefog_tpu import checkpoint as ckpt
+from bluefog_tpu import optimizers as bfopt
+from bluefog_tpu import topology as tu
+
+N = 8
+
+
+@pytest.fixture(autouse=True)
+def ctx(cpu_devices):
+    bf.init(devices=cpu_devices, nodes_per_machine=1)
+    bf.set_topology(tu.ExponentialTwoGraph(N), is_weighted=True)
+    yield
+    bf.shutdown()
+
+
+def test_roundtrip(tmp_path):
+    tree = {
+        "w": jnp.asarray(np.random.default_rng(0).normal(size=(N, 4, 3)),
+                         jnp.float32),
+        "step": jnp.asarray(7, jnp.int32),
+    }
+    path = ckpt.save(str(tmp_path), tree, step=7)
+    assert ckpt.latest_step(str(tmp_path)) == 7
+    out = ckpt.restore(path, template=tree)
+    for a, b in zip(jax.tree.leaves(out), jax.tree.leaves(tree)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_resume_training_is_bitwise_identical(tmp_path):
+    """Train 3 steps, checkpoint, train 3 more; vs restore + 3 -> identical."""
+    target = jnp.ones((N, 1, 5)) * 2.0
+
+    def grad_fn(params, batch):
+        return jax.value_and_grad(
+            lambda p: jnp.mean((p["x"] - batch) ** 2))(params)
+
+    strategy = bfopt.adapt_with_combine(
+        optax.sgd(0.2, momentum=0.9),
+        bfopt.neighbor_communicator(bf.static_schedule()))
+    step = bfopt.make_train_step(grad_fn, strategy)
+
+    params = {"x": jnp.asarray(
+        np.random.default_rng(1).normal(size=(N, 1, 5)), jnp.float32)}
+    state = bfopt.init_distributed(strategy, params)
+    for _ in range(3):
+        params, state, loss = step(params, state, target)
+        jax.block_until_ready(loss)
+    ckpt.save(str(tmp_path), {"params": params, "state": state}, step=3)
+
+    cont_params, cont_state = params, state
+    for _ in range(3):
+        cont_params, cont_state, _ = step(cont_params, cont_state, target)
+
+    restored, at = ckpt.restore_latest(
+        str(tmp_path), template={"params": params, "state": state})
+    assert at == 3
+    r_params, r_state = restored["params"], restored["state"]
+    # orbax restores plain arrays; the optimizer state tuple structure must be
+    # rebuilt from the template pytree — verified by running steps on it
+    r_state = jax.tree.unflatten(
+        jax.tree.structure(state), jax.tree.leaves(r_state))
+    for _ in range(3):
+        r_params, r_state, _ = step(r_params, r_state, target)
+
+    for a, b in zip(jax.tree.leaves(cont_params), jax.tree.leaves(r_params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_keep_prunes_old(tmp_path):
+    tree = {"x": jnp.zeros((N, 2))}
+    for s in (1, 2, 3, 4):
+        ckpt.save(str(tmp_path), tree, step=s, keep=2)
+    assert ckpt.all_steps(str(tmp_path)) == [3, 4]
+
+
+def test_restore_latest_empty(tmp_path):
+    out, step = ckpt.restore_latest(str(tmp_path))
+    assert out is None and step is None

@@ -1,0 +1,46 @@
+# Test / build matrix (counterpart of the reference's mpirun-driven Makefile,
+# Makefile:22-62 — here the "cluster" is the 8-device virtual CPU mesh the
+# conftest provisions, so plain pytest plays the role of `mpirun -np 4 pytest`).
+
+PY ?= python
+
+.PHONY: test test_basic test_ops test_win_ops test_optimizer test_hier \
+	test_native test_examples native clean
+
+test:
+	$(PY) -m pytest tests/ -q
+
+test_basic:
+	$(PY) -m pytest tests/test_topology.py tests/test_schedule.py -q
+
+test_ops:
+	$(PY) -m pytest tests/test_ops.py tests/test_ring.py tests/test_fusion.py -q
+
+test_win_ops:
+	$(PY) -m pytest tests/test_win_ops.py -q
+
+test_optimizer:
+	$(PY) -m pytest tests/test_optimizers.py tests/test_haiku.py -q
+
+test_hier:
+	$(PY) -m pytest tests/test_hierarchical.py -q
+
+test_native:
+	$(PY) -m pytest tests/test_native.py -q
+
+# e2e example smoke (counterpart of test/test_all_example.sh)
+test_examples:
+	$(PY) examples/average_consensus.py --virtual-cpu --data-size 100
+	$(PY) examples/average_consensus.py --virtual-cpu --dynamic
+	$(PY) examples/decentralized_optimization.py --virtual-cpu
+	$(PY) examples/benchmark.py --virtual-cpu --model mlp --num-iters 3
+	$(PY) examples/benchmark.py --virtual-cpu --model mlp --num-iters 3 \
+		--dist-optimizer allreduce
+
+# build the native (C++) components explicitly (otherwise built lazily)
+native:
+	$(PY) -c "from bluefog_tpu import _native; assert _native.available()"
+
+clean:
+	rm -f bluefog_tpu/_native/libbft_native.so
+	find . -name __pycache__ -type d -exec rm -rf {} +

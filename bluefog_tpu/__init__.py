@@ -17,6 +17,8 @@ from . import topology as topology_util       # reference-familiar alias
 from . import schedule
 from . import ops
 from . import optimizers
+from . import fusion
+from . import checkpoint
 from . import utils
 from .utils import (
     timeline_start_activity, timeline_end_activity, timeline_context,

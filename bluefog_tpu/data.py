@@ -1,0 +1,127 @@
+"""Sharded input pipeline: host batching + async device prefetch.
+
+The reference leans on torch ``DataLoader`` + ``DistributedSampler`` (each
+rank a process, e.g. ``examples/pytorch_mnist.py``); here one host process
+feeds every rank, so the pipeline (a) shards each batch across the rank axis,
+(b) stages host->device transfers ahead of compute with a small prefetch
+queue so the copy of batch t+1 overlaps the step on batch t — the role the
+reference's loader worker processes play.
+
+Works with any indexable source of numpy arrays (arrays, memmaps, or a
+callable producing per-index samples).
+"""
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Any, Callable, Iterator, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .parallel import context as _mesh
+
+__all__ = ["ShardedLoader", "prefetch_to_device"]
+
+
+class ShardedLoader:
+    """Iterate ``(x, y, ...)`` arrays as rank-sharded device batches.
+
+    Each epoch yields ``steps_per_epoch`` pytrees whose leaves have shape
+    ``[n_ranks, batch_size, ...]``, placed on the mesh with the leading axis
+    sharded (``PartitionSpec('rank')``).  Distinct ranks see distinct shards
+    (the decentralized-training contract); set ``shuffle`` for a new
+    per-epoch permutation.
+    """
+
+    def __init__(
+        self,
+        arrays: Sequence[np.ndarray],
+        batch_size: int,
+        *,
+        shuffle: bool = True,
+        seed: int = 0,
+        drop_remainder: bool = True,
+        prefetch: int = 2,
+    ):
+        if not arrays:
+            raise ValueError("need at least one array")
+        n0 = len(arrays[0])
+        if any(len(a) != n0 for a in arrays):
+            raise ValueError("arrays must share their first dimension")
+        self.arrays = [np.asarray(a) for a in arrays]
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.seed = seed
+        self.prefetch = prefetch
+        if not drop_remainder:
+            raise NotImplementedError(
+                "static shapes require drop_remainder=True on TPU")
+        self._epoch = 0
+
+    @property
+    def num_samples(self) -> int:
+        return len(self.arrays[0])
+
+    def steps_per_epoch(self) -> int:
+        n = _mesh.size()
+        return self.num_samples // n // self.batch_size
+
+    def __iter__(self) -> Iterator[Tuple[jax.Array, ...]]:
+        n = _mesh.size()
+        ctx = _mesh.get_context()
+        sharding = NamedSharding(ctx.mesh, P("rank"))
+        steps = self.steps_per_epoch()
+        if steps == 0:
+            raise ValueError(
+                f"{self.num_samples} samples < one global batch "
+                f"({n} ranks x {self.batch_size})")
+        order = np.arange(self.num_samples)
+        if self.shuffle:
+            order = np.random.default_rng(
+                self.seed + self._epoch).permutation(order)
+        self._epoch += 1
+        per_rank = self.num_samples // n
+
+        def host_batches():
+            for s in range(steps):
+                batch = []
+                for a in self.arrays:
+                    # rank r reads shard r: [n, B, ...]
+                    idx = np.stack([
+                        order[r * per_rank + s * self.batch_size:
+                              r * per_rank + (s + 1) * self.batch_size]
+                        for r in range(n)
+                    ])
+                    batch.append(a[idx])
+                yield tuple(batch)
+
+        yield from prefetch_to_device(
+            host_batches(), sharding, size=self.prefetch)
+
+
+def prefetch_to_device(
+    iterator: Iterator[Any],
+    sharding: Optional[NamedSharding] = None,
+    *,
+    size: int = 2,
+) -> Iterator[Any]:
+    """Stage host pytrees onto the mesh ``size`` batches ahead.
+
+    ``jax.device_put`` is async, so keeping a small queue of in-flight
+    transfers overlaps PCIe/DMA copies with the current step's compute.
+    """
+    if sharding is None:
+        sharding = NamedSharding(_mesh.get_context().mesh, P("rank"))
+    queue: collections.deque = collections.deque()
+
+    def put(batch):
+        return jax.tree.map(lambda a: jax.device_put(a, sharding), batch)
+
+    for batch in iterator:
+        queue.append(put(batch))
+        if len(queue) > size:
+            yield queue.popleft()
+    while queue:
+        yield queue.popleft()

@@ -47,6 +47,8 @@ def build_parser() -> argparse.ArgumentParser:
                         "(sets BLUEFOG_TIMELINE; reference: bfrun flag)")
     p.add_argument("-x", "--env", action="append", default=[],
                    help="extra NAME=VALUE env for the child (repeatable)")
+    p.add_argument("--no-xla-tuning", action="store_true",
+                   help="do not add the recommended TPU overlap XLA flags")
     p.add_argument("command", nargs=argparse.REMAINDER,
                    help="the training command, e.g. python train.py")
     return p
@@ -61,6 +63,11 @@ def _child_env(args) -> dict:
         env[k] = v
     if args.timeline_filename:
         env["BLUEFOG_TIMELINE"] = args.timeline_filename
+    if not args.no_xla_tuning:
+        from ..utils.config import RECOMMENDED_TPU_XLA_FLAGS
+        flags = env.get("XLA_FLAGS", "")
+        if "xla_tpu_enable_async_collective_fusion" not in flags:
+            env["XLA_FLAGS"] = (RECOMMENDED_TPU_XLA_FLAGS + " " + flags).strip()
     return env
 
 

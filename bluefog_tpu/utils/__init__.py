@@ -7,6 +7,7 @@ from .utility import (
     broadcast_parameters, allreduce_parameters, broadcast_optimizer_state,
 )
 from .config import env_flag, env_int, env_float
+from .watchdog import synchronize_with_watchdog
 
 __all__ = [
     "timeline_start_activity", "timeline_end_activity", "timeline_context",
@@ -14,4 +15,5 @@ __all__ = [
     "broadcast_parameters", "allreduce_parameters",
     "broadcast_optimizer_state",
     "env_flag", "env_int", "env_float",
+    "synchronize_with_watchdog",
 ]

@@ -35,6 +35,32 @@ def env_float(name: str, default: Optional[float] = None) -> Optional[float]:
     return default if v is None else float(v)
 
 
+# Latency-hiding scheduler flags: let XLA overlap gossip collectives with
+# backward compute — the role the reference's background comm thread +
+# nonblocking ops play (SURVEY.md §7 "hard parts" (5)).  This is the standard
+# public TPU training flag set (async collective fusion across steps).
+RECOMMENDED_TPU_XLA_FLAGS = (
+    "--xla_tpu_enable_async_collective_fusion=true "
+    "--xla_tpu_enable_async_collective_fusion_fuse_all_gather=true "
+    "--xla_tpu_enable_async_collective_fusion_multiple_steps=true "
+    "--xla_tpu_overlap_compute_collective_tc=true "
+    "--xla_enable_async_all_gather=true"
+)
+
+
+def apply_recommended_xla_flags() -> bool:
+    """Prepend the TPU overlap flags to ``XLA_FLAGS`` (idempotent).
+
+    Must run before the JAX backend initializes; returns False (no-op) when
+    the flags are already present.
+    """
+    current = os.environ.get("XLA_FLAGS", "")
+    if "xla_tpu_enable_async_collective_fusion" in current:
+        return False
+    os.environ["XLA_FLAGS"] = (RECOMMENDED_TPU_XLA_FLAGS + " " + current).strip()
+    return True
+
+
 def setup_logging() -> None:
     level = os.environ.get("BLUEFOG_LOG_LEVEL", "warning").upper()
     if level in ("TRACE",):

@@ -1,0 +1,54 @@
+"""Stall detection for distributed steps.
+
+Counterpart of the reference's stalled-tensor watchdog
+(``CheckForStalledTensors``, ``operations.cc:388-433``): there, rank 0 prints
+which ranks' requests have been missing for 60 s (a rank diverged or died).
+Under SPMD the failure mode is different — a step is one compiled program, so
+a "stall" is a device computation that never completes (preempted host,
+wedged ICI link) — and the watchdog watches wall-clock completion instead:
+``synchronize_with_watchdog`` blocks on a result and logs an escalating
+warning every ``interval`` seconds until it lands, so a hung multi-host job
+says *that* it is stuck and for how long rather than sitting silent.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Optional
+
+import jax
+
+from .config import logger
+
+DEFAULT_INTERVAL_S = 60.0   # reference: STALL_WARNING_TIME, operations.cc:47
+
+
+def synchronize_with_watchdog(
+    x: Any,
+    interval: float = DEFAULT_INTERVAL_S,
+    name: str = "step",
+) -> Any:
+    """``jax.block_until_ready(x)`` that complains while it waits.
+
+    Logs a warning every ``interval`` seconds until the computation backing
+    ``x`` completes; returns ``x``.  Zero overhead on the happy path beyond
+    one timer thread that is cancelled on completion.
+    """
+    done = threading.Event()
+    t0 = time.monotonic()
+
+    def watch():
+        n = 0
+        while not done.wait(interval):
+            n += 1
+            logger.warning(
+                "%s has not completed after %.0f s — one or more devices/"
+                "hosts may be stalled (reference: stalled-tensor warning)",
+                name, time.monotonic() - t0)
+
+    t = threading.Thread(target=watch, daemon=True)
+    t.start()
+    try:
+        return jax.block_until_ready(x)
+    finally:
+        done.set()

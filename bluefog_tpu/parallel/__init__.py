@@ -22,6 +22,10 @@ from .context import (
     static_schedule,
     machine_schedule,
     get_context,
+    machine_rank,
+    local_rank,
+    suspend,
+    resume,
 )
 
 __all__ = [
@@ -33,6 +37,7 @@ __all__ = [
     "in_neighbor_ranks", "out_neighbor_ranks",
     "in_neighbor_machine_ranks", "out_neighbor_machine_ranks",
     "static_schedule", "machine_schedule", "get_context",
+    "machine_rank", "local_rank", "suspend", "resume",
 ]
 
 from .windows import (

@@ -229,6 +229,27 @@ def set_machine_topology(topology: nx.DiGraph, is_weighted: bool = False) -> boo
     return True
 
 
+def machine_rank(rank: int) -> int:
+    """Machine id of ``rank`` (reference: ``bf.machine_rank()`` — ambient
+    there; takes the rank here since SPMD host code sees all ranks)."""
+    return int(rank) // get_context().nodes_per_machine
+
+
+def local_rank(rank: int) -> int:
+    """Rank within its machine (reference: ``bf.local_rank()``)."""
+    return int(rank) % get_context().nodes_per_machine
+
+
+def suspend() -> None:
+    """No-op (reference: ``bf.suspend``, ``basics.py:548-568`` — parks the
+    MPI background thread for Jupyter cell boundaries; there is no
+    background thread here)."""
+
+
+def resume() -> None:
+    """No-op counterpart of :func:`suspend`."""
+
+
 def in_neighbor_ranks(rank: int) -> List[int]:
     """Sorted in-neighbors of ``rank`` in the current topology."""
     return topo_util.GetInNeighbors(get_context().topology, rank)

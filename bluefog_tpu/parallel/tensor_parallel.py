@@ -1,0 +1,96 @@
+"""Tensor parallelism: column/row-sharded layers over a mesh axis.
+
+Beyond the reference (whose strategies are all data-parallel, SURVEY.md
+§2.4): on TPU the natural second mesh axis is *model* parallelism — weights
+sharded across chips, activations exchanged with one ``psum`` per block (the
+Megatron pattern, mapped onto ICI).  These helpers compose with the gossip
+data-parallel strategies on a 2-D ``(rank, model)`` mesh: gossip averages
+each weight shard across the ``rank`` axis while the ``model`` axis carries
+the intra-layer collectives.
+
+All modules are plain flax layers meant to run inside ``shard_map`` with a
+``model`` axis in scope; each device materializes only its shard of the
+weight (init inside the mapped function gives per-shard shapes
+automatically).
+
+    col = ColumnParallelDense(features=4096, axis="model")   # splits outputs
+    row = RowParallelDense(features=1024, axis="model")      # splits inputs,
+                                                             # psums outputs
+    y = row(nn.gelu(col(x)))     # one psum total, weights 1/n per device
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["ColumnParallelDense", "RowParallelDense", "TPMlpBlock"]
+
+
+def _axis_size(axis: Optional[str]) -> int:
+    return 1 if axis is None else lax.axis_size(axis)
+
+
+class ColumnParallelDense(nn.Module):
+    """Dense with output features split across ``axis``.
+
+    Each device computes its ``features / axis_size`` output columns; no
+    communication in the forward pass (the activation stays sharded).
+    """
+    features: int
+    axis: Optional[str] = None
+    use_bias: bool = True
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x):
+        n = _axis_size(self.axis)
+        if self.features % n:
+            raise ValueError(
+                f"features {self.features} not divisible by model-axis size {n}")
+        return nn.Dense(self.features // n, use_bias=self.use_bias,
+                        dtype=self.dtype)(x)
+
+
+class RowParallelDense(nn.Module):
+    """Dense with input features split across ``axis``.
+
+    Consumes a column-sharded activation; each device computes a partial
+    output which one ``psum`` over ``axis`` completes.  Bias is added after
+    the reduction (applied once).
+    """
+    features: int
+    axis: Optional[str] = None
+    use_bias: bool = True
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x):
+        y = nn.Dense(self.features, use_bias=False, dtype=self.dtype)(x)
+        if self.axis is not None:
+            y = lax.psum(y, self.axis)
+        if self.use_bias:
+            bias = self.param("bias", nn.initializers.zeros_init(),
+                              (self.features,), y.dtype)
+            y = y + bias
+        return y
+
+
+class TPMlpBlock(nn.Module):
+    """Column -> activation -> row parallel MLP (one psum per block)."""
+    hidden: int
+    features: int
+    axis: Optional[str] = None
+    activation: Callable = nn.gelu
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, x):
+        h = ColumnParallelDense(self.hidden, axis=self.axis,
+                                dtype=self.dtype)(x)
+        h = self.activation(h)
+        return RowParallelDense(self.features, axis=self.axis,
+                                dtype=self.dtype)(h)

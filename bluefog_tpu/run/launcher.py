@@ -49,6 +49,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="extra NAME=VALUE env for the child (repeatable)")
     p.add_argument("--no-xla-tuning", action="store_true",
                    help="do not add the recommended TPU overlap XLA flags")
+    p.add_argument("--interactive", action="store_true",
+                   help="drop into an initialized Python REPL instead of "
+                        "running a command (reference: ibfrun — under SPMD "
+                        "one session sees every rank, so no ipyparallel "
+                        "cluster is needed)")
     p.add_argument("command", nargs=argparse.REMAINDER,
                    help="the training command, e.g. python train.py")
     return p
@@ -73,6 +78,14 @@ def _child_env(args) -> dict:
 
 def main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
+    if args.interactive:
+        env = _child_env(args)
+        return subprocess.call(
+            [sys.executable, "-i", "-c",
+             "import bluefog_tpu as bf; bf.init(); "
+             "print(f'bluefog_tpu ready: {bf.size()} rank(s), "
+             "topology={bf.load_topology().__class__.__name__}')"],
+            env=env)
     if not args.command:
         build_parser().print_help()
         return 2

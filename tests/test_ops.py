@@ -217,3 +217,16 @@ def test_dtypes():
         x = rank_tensor(dtype=dtype)
         out = bf.neighbor_allreduce(x)
         assert out.dtype == dtype
+
+
+def test_integer_dtypes():
+    """Sum-reductions on integer tensors (reference dtype matrix includes
+    int types, torch_ops_test.py)."""
+    for dtype in (jnp.int32, jnp.uint8):  # (int64 needs jax x64 mode)
+        x = jnp.broadcast_to(jnp.arange(N, dtype=dtype)[:, None], (N, DIM))
+        out = bf.allreduce(x, average=False)
+        assert out.dtype == dtype
+        np.testing.assert_array_equal(
+            np.asarray(out), np.full((N, DIM), N * (N - 1) // 2))
+        bc = bf.broadcast(x, root_rank=5)
+        np.testing.assert_array_equal(np.asarray(bc), np.full((N, DIM), 5))

@@ -41,7 +41,7 @@ from .parallel import (
     turn_on_win_ops_with_associated_p, turn_off_win_ops_with_associated_p,
 )
 from .api import (
-    allreduce, allgather, broadcast,
+    allreduce, allgather, ragged_allgather, broadcast,
     neighbor_allreduce, neighbor_allgather,
     pair_gossip, hierarchical_neighbor_allreduce,
     barrier, synchronize, poll, resolve_schedule, shard_distributed,

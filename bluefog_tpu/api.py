@@ -29,8 +29,9 @@ from .parallel import context as _mesh
 from .schedule import CommSchedule, compile_from_weights
 
 __all__ = [
-    "allreduce", "allgather", "broadcast", "neighbor_allreduce",
-    "neighbor_allgather", "pair_gossip", "hierarchical_neighbor_allreduce",
+    "allreduce", "allgather", "ragged_allgather", "broadcast",
+    "neighbor_allreduce", "neighbor_allgather", "pair_gossip",
+    "hierarchical_neighbor_allreduce",
     "barrier", "synchronize", "poll", "resolve_schedule", "shard_distributed",
 ]
 
@@ -214,6 +215,24 @@ def allgather(x: jax.Array) -> jax.Array:
         lambda: _shard_map_1d(
             _per_rank(partial(ops.allgather, axis="rank")), ctx.mesh))
     return fn(x)
+
+
+def ragged_allgather(x: jax.Array, lengths) -> Tuple[jax.Array, jax.Array]:
+    """Allgather of per-rank slices with *different* valid first dims.
+
+    The reference's allgather accepts tensors whose first dimension differs
+    per rank (it pre-negotiates sizes, ``mpi_context.cc:643-717``;
+    ``torch_ops_test.py:322``).  XLA needs static shapes, so the TPU contract
+    is pad + length channel: ``x`` is ``[n, max_d0, ...]`` with rank r's
+    valid data in ``x[r, :lengths[r]]``.  Returns ``(gathered, lengths)``
+    where ``gathered[r]`` is ``[n * max_d0, ...]`` (every rank's padded
+    slice, in rank order) and ``lengths`` is replicated so each rank can
+    slice out the valid prefixes.
+    """
+    ctx = _mesh.get_context()
+    _check_distributed(x, ctx.size)
+    lengths = jnp.asarray(lengths, jnp.int32).reshape(ctx.size, 1)
+    return allgather(x), allgather(lengths)
 
 
 def broadcast(x: jax.Array, root_rank: int) -> jax.Array:

@@ -230,3 +230,21 @@ def test_integer_dtypes():
             np.asarray(out), np.full((N, DIM), N * (N - 1) // 2))
         bc = bf.broadcast(x, root_rank=5)
         np.testing.assert_array_equal(np.asarray(bc), np.full((N, DIM), 5))
+
+
+def test_ragged_allgather():
+    """Variable-first-dim gather via pad + length channel (reference
+    torch_ops_test.py:322 variable-dim allgather)."""
+    max_d0 = 4
+    lengths = np.array([r % max_d0 + 1 for r in range(N)])
+    x = np.zeros((N, max_d0, 2), np.float32)
+    for r in range(N):
+        x[r, :lengths[r]] = r
+    g, glens = bf.ragged_allgather(jnp.asarray(x), lengths)
+    assert g.shape == (N, N * max_d0, 2)
+    for r in range(N):
+        got_lens = np.asarray(glens[r]).ravel()
+        np.testing.assert_array_equal(got_lens, lengths)
+        for s in range(N):
+            valid = np.asarray(g[r, s * max_d0: s * max_d0 + got_lens[s]])
+            np.testing.assert_array_equal(valid, np.full(valid.shape, s))

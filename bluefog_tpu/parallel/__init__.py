@@ -53,3 +53,9 @@ __all__ += [
     "win_associated_p",
     "turn_on_win_ops_with_associated_p", "turn_off_win_ops_with_associated_p",
 ]
+
+from . import tensor_parallel
+from . import pipeline
+from . import expert
+
+__all__ += ["tensor_parallel", "pipeline", "expert"]

@@ -1,0 +1,100 @@
+"""Expert parallelism: capacity-based MoE dispatch over a mesh axis.
+
+Beyond the reference (data-parallel only, SURVEY.md §2.4): each device along
+the ``expert`` axis owns one expert; tokens are routed to their expert's
+device with one ``lax.all_to_all``, transformed, and routed back with a
+second.  Dispatch is the standard static-capacity scheme (XLA needs static
+shapes): each (source device, expert) pair gets ``capacity`` slots, tokens
+beyond capacity are dropped (their combined output is zero — multiply by the
+router gate outside, as usual for MoE).
+
+    y = moe_apply(x, expert_idx, expert_fn, params, capacity=C, axis="expert")
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["moe_dispatch", "moe_combine", "moe_apply"]
+
+Axis = str
+
+
+def _routing(expert_idx: jax.Array, num_experts: int, capacity: int):
+    """Per-token slot assignment: (slot position within expert, kept?)."""
+    onehot = jax.nn.one_hot(expert_idx, num_experts, dtype=jnp.int32)  # [T,E]
+    pos = jnp.sum((jnp.cumsum(onehot, axis=0) - 1) * onehot, axis=1)   # [T]
+    keep = pos < capacity
+    return pos, keep
+
+
+def moe_dispatch(
+    x: jax.Array,                # [T, D] this device's tokens
+    expert_idx: jax.Array,       # [T] int: chosen expert per token
+    *,
+    capacity: int,
+    axis: Axis = "expert",
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Route tokens to expert owners.
+
+    Returns ``(expert_in [n_src * capacity, D], pos, keep)`` where
+    ``expert_in`` holds, on the device owning expert e, the tokens every
+    source device routed to e (zeros in unused slots); ``pos``/``keep`` are
+    needed by :func:`moe_combine` for the return path.
+    """
+    n = lax.axis_size(axis)
+    T, D = x.shape
+    pos, keep = _routing(expert_idx, n, capacity)
+    slot = jnp.where(keep, pos, capacity - 1)
+    buf = jnp.zeros((n, capacity, D), x.dtype)
+    buf = buf.at[expert_idx, slot].add(
+        x * keep[:, None].astype(x.dtype))                 # [E, C, D]
+    # device d's block e -> device e's block d
+    swapped = lax.all_to_all(buf, axis, split_axis=0, concat_axis=0,
+                             tiled=True)                   # [n*C, D] by source
+    return swapped, pos, keep
+
+
+def moe_combine(
+    expert_out: jax.Array,       # [n_src * capacity, D] transformed tokens
+    expert_idx: jax.Array,
+    pos: jax.Array,
+    keep: jax.Array,
+    *,
+    capacity: int,
+    axis: Axis = "expert",
+) -> jax.Array:
+    """Inverse of :func:`moe_dispatch`: bring each token's output home.
+
+    Dropped tokens come back as zeros.
+    """
+    n = lax.axis_size(axis)
+    D = expert_out.shape[-1]
+    back = lax.all_to_all(expert_out.reshape(n, capacity, D), axis,
+                          split_axis=0, concat_axis=0, tiled=True)
+    back = back.reshape(n, capacity, D)                    # [E, C, D]
+    slot = jnp.where(keep, pos, capacity - 1)
+    y = back[expert_idx, slot]
+    return y * keep[:, None].astype(y.dtype)
+
+
+def moe_apply(
+    x: jax.Array,
+    expert_idx: jax.Array,
+    expert_fn: Callable[[Any, jax.Array], jax.Array],
+    expert_params: Any,
+    *,
+    capacity: int,
+    axis: Axis = "expert",
+) -> jax.Array:
+    """Dispatch -> this device's expert -> combine (one MoE layer)."""
+    expert_in, pos, keep = moe_dispatch(
+        x, expert_idx, capacity=capacity, axis=axis)
+    expert_out = expert_fn(expert_params, expert_in)
+    if expert_out.shape != expert_in.shape:
+        raise ValueError("expert_fn must preserve [tokens, D] shape")
+    return moe_combine(expert_out, expert_idx, pos, keep,
+                       capacity=capacity, axis=axis)

@@ -1,0 +1,92 @@
+"""Pipeline parallelism: GPipe-style stage execution over a mesh axis.
+
+Beyond the reference (data-parallel only, SURVEY.md §2.4): stages of a layer
+stack live on consecutive devices along a ``stage`` mesh axis and microbatch
+activations flow stage-to-stage with ``ppermute`` — the same primitive the
+gossip layer and ring attention use, pointed down a line instead of around a
+ring.
+
+The schedule is the classic GPipe loop unrolled as ``lax.scan`` over
+``num_micro + num_stages - 1`` ticks: at tick t, stage s computes microbatch
+``t - s`` (when in range) and passes its activation to stage s+1.  Each
+device executes every tick (SPMD), with out-of-range ticks masked — the
+bubble is the standard ``(S-1)/(M+S-1)`` overhead.
+
+Composable with gossip DP: put ``stage`` next to ``rank`` on a 2-D mesh and
+gossip each stage's parameters over ``rank`` as usual.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["pipeline_apply"]
+
+Axis = str
+
+
+def pipeline_apply(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    stage_params: Any,
+    microbatches: jax.Array,
+    *,
+    axis: Axis = "stage",
+) -> jax.Array:
+    """Run a stage-partitioned network over microbatches.
+
+    Args:
+      stage_fn: ``(params, x) -> y`` for ONE stage; activations ``x``/``y``
+        must share one shape/dtype across stages (the pipeline contract).
+      stage_params: this device's stage parameters (pytree).
+      microbatches: ``[num_micro, ...]`` input microbatches.  Only stage 0
+        reads them; other stages receive activations from their predecessor.
+      axis: the mesh axis stages live on.
+
+    Returns:
+      ``[num_micro, ...]`` outputs of the LAST stage (other stages return
+      zeros of the same shape — select by ``lax.axis_index(axis)`` outside,
+      or psum if only the final value is consumed).
+    """
+    n_stage = lax.axis_size(axis)
+    sid = lax.axis_index(axis)
+    num_micro = microbatches.shape[0]
+    ticks = num_micro + n_stage - 1
+    act_shape = microbatches.shape[1:]
+
+    fwd = tuple((i, i + 1) for i in range(n_stage - 1))   # stage s -> s+1
+
+    def tick(carry, t):
+        inbox, outputs = carry
+        # stage 0 injects microbatch t; others use the inbox from upstream
+        mb_idx = jnp.clip(t, 0, num_micro - 1)
+        x0 = lax.dynamic_index_in_dim(microbatches, mb_idx, keepdims=False)
+        x = jnp.where(sid == 0, x0, inbox)
+        # my microbatch id at this tick; valid iff 0 <= t - sid < num_micro
+        my_mb = t - sid
+        valid = (my_mb >= 0) & (my_mb < num_micro)
+        y = stage_fn(stage_params, x)
+        y = jnp.where(valid, y, jnp.zeros_like(y))
+        # last stage records its finished microbatch
+        record = valid & (sid == n_stage - 1)
+        slot = jnp.clip(my_mb, 0, num_micro - 1)
+        cur = lax.dynamic_index_in_dim(outputs, slot, keepdims=False)
+        outputs = lax.dynamic_update_index_in_dim(
+            outputs, jnp.where(record, y, cur), slot, axis=0)
+        # ship activations downstream (stage s -> s+1); last stage's send is
+        # dropped by the partial permutation
+        inbox = lax.ppermute(y, axis, perm=fwd) if fwd else y
+        return (inbox, outputs), None
+
+    # pcast: the carries become varying over the stage axis after the first
+    # permute/indexed write, so the scan carry type must start varying too
+    inbox0 = lax.pcast(
+        jnp.zeros(act_shape, microbatches.dtype), axis, to='varying')
+    outputs0 = lax.pcast(
+        jnp.zeros((num_micro,) + act_shape, microbatches.dtype), axis,
+        to='varying')
+    (_, outputs), _ = lax.scan(
+        tick, (inbox0, outputs0), jnp.arange(ticks))
+    return outputs

@@ -20,7 +20,7 @@ import jax
 BASELINE_PER_GPU = 4310.6 / 16  # reference: img/sec per V100, 16-GPU run
 
 
-def _probe_accelerator(timeout: float = 240.0) -> bool:
+def _probe_accelerator(extra_env=None, timeout: float = 240.0) -> bool:
     """Check in a subprocess that accelerator backend init completes.
 
     The axon TPU plugin dials a tunnel during PJRT client creation; when the
@@ -28,19 +28,48 @@ def _probe_accelerator(timeout: float = 240.0) -> bool:
     Probing in a child process lets the benchmark fall back to CPU instead of
     hanging the driver.
     """
-    try:
-        r = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; d = jax.devices(); "
-             "assert any(x.platform != 'cpu' for x in d)"],
-            timeout=timeout, capture_output=True)
-        return r.returncode == 0
-    except subprocess.TimeoutExpired:
-        return False
+    import os
+    env = dict(os.environ)
+    if extra_env:
+        env.update(extra_env)
+    return _start_probe(env).wait(timeout) == 0
+
+
+def _start_probe(env) -> "subprocess.Popen":
+    return subprocess.Popen(
+        [sys.executable, "-c",
+         "import jax; d = jax.devices(); "
+         "assert any(x.platform != 'cpu' for x in d)"],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
 
 
 def main():
-    on_accelerator = _probe_accelerator()
+    import os
+    from bluefog_tpu.utils.config import RECOMMENDED_TPU_XLA_FLAGS
+
+    # Probe the accelerator twice CONCURRENTLY — once with the overlap flags
+    # (a real TPU jaxlib accepts them; a CPU-only or tunnel-client jaxlib
+    # fatally aborts on unknown --xla_tpu_* flags) and once without.  When
+    # the tunnel is down both hang, so concurrency keeps the worst case to
+    # one timeout instead of two.
+    tuned_flags = (RECOMMENDED_TPU_XLA_FLAGS + " "
+                   + os.environ.get("XLA_FLAGS", "")).strip()
+    tuned_env = dict(os.environ, XLA_FLAGS=tuned_flags)
+    p_tuned, p_plain = _start_probe(tuned_env), _start_probe(dict(os.environ))
+    deadline = time.monotonic() + 240.0
+    while time.monotonic() < deadline and (
+            p_tuned.poll() is None or p_plain.poll() is None):
+        if p_tuned.poll() == 0 or p_plain.poll() == 0:
+            break
+        time.sleep(1.0)
+    for p in (p_tuned, p_plain):
+        if p.poll() is None:
+            p.kill()
+    if p_tuned.returncode == 0:
+        on_accelerator = True
+        os.environ["XLA_FLAGS"] = tuned_flags
+    else:
+        on_accelerator = p_plain.returncode == 0
     if not on_accelerator:
         print("bench: accelerator unreachable, falling back to CPU "
               "(tiny shapes; the number is NOT the TPU headline)",

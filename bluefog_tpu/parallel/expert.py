@@ -40,10 +40,10 @@ def moe_dispatch(
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Route tokens to expert owners.
 
-    Returns ``(expert_in [n_src * capacity, D], pos, keep)`` where
-    ``expert_in`` holds, on the device owning expert e, the tokens every
-    source device routed to e (zeros in unused slots); ``pos``/``keep`` are
-    needed by :func:`moe_combine` for the return path.
+    Returns ``(expert_in [n_src, capacity, D], pos, keep)``: on the device
+    owning expert e, ``expert_in[s]`` holds the tokens source device s routed
+    to e (zeros in unused slots); ``pos``/``keep`` are needed by
+    :func:`moe_combine` for the return path.
     """
     n = lax.axis_size(axis)
     T, D = x.shape
@@ -52,14 +52,15 @@ def moe_dispatch(
     buf = jnp.zeros((n, capacity, D), x.dtype)
     buf = buf.at[expert_idx, slot].add(
         x * keep[:, None].astype(x.dtype))                 # [E, C, D]
-    # device d's block e -> device e's block d
+    # device d's block e -> device e's block d (shape-preserving swap:
+    # tiled all_to_all with split_axis == concat_axis)
     swapped = lax.all_to_all(buf, axis, split_axis=0, concat_axis=0,
-                             tiled=True)                   # [n*C, D] by source
+                             tiled=True)                   # [n_src, C, D]
     return swapped, pos, keep
 
 
 def moe_combine(
-    expert_out: jax.Array,       # [n_src * capacity, D] transformed tokens
+    expert_out: jax.Array,       # [n_src, capacity, D] transformed tokens
     expert_idx: jax.Array,
     pos: jax.Array,
     keep: jax.Array,
@@ -71,11 +72,8 @@ def moe_combine(
 
     Dropped tokens come back as zeros.
     """
-    n = lax.axis_size(axis)
-    D = expert_out.shape[-1]
-    back = lax.all_to_all(expert_out.reshape(n, capacity, D), axis,
-                          split_axis=0, concat_axis=0, tiled=True)
-    back = back.reshape(n, capacity, D)                    # [E, C, D]
+    back = lax.all_to_all(expert_out, axis,
+                          split_axis=0, concat_axis=0, tiled=True)  # [E, C, D]
     slot = jnp.where(keep, pos, capacity - 1)
     y = back[expert_idx, slot]
     return y * keep[:, None].astype(y.dtype)
@@ -90,11 +88,16 @@ def moe_apply(
     capacity: int,
     axis: Axis = "expert",
 ) -> jax.Array:
-    """Dispatch -> this device's expert -> combine (one MoE layer)."""
+    """Dispatch -> this device's expert -> combine (one MoE layer).
+
+    ``expert_fn(params, tokens)`` receives the flattened ``[n_src * capacity,
+    D]`` token matrix (zeros in unused slots) and must preserve its shape.
+    """
     expert_in, pos, keep = moe_dispatch(
         x, expert_idx, capacity=capacity, axis=axis)
-    expert_out = expert_fn(expert_params, expert_in)
-    if expert_out.shape != expert_in.shape:
+    n_src, cap, D = expert_in.shape
+    expert_out = expert_fn(expert_params, expert_in.reshape(n_src * cap, D))
+    if expert_out.shape != (n_src * cap, D):
         raise ValueError("expert_fn must preserve [tokens, D] shape")
-    return moe_combine(expert_out, expert_idx, pos, keep,
-                       capacity=capacity, axis=axis)
+    return moe_combine(expert_out.reshape(n_src, cap, D), expert_idx, pos,
+                       keep, capacity=capacity, axis=axis)

@@ -69,9 +69,12 @@ def _child_env(args) -> dict:
     if args.timeline_filename:
         env["BLUEFOG_TIMELINE"] = args.timeline_filename
     if not args.no_xla_tuning:
-        from ..utils.config import RECOMMENDED_TPU_XLA_FLAGS
+        from ..utils.config import (
+            RECOMMENDED_TPU_XLA_FLAGS, looks_like_tpu_environment)
         flags = env.get("XLA_FLAGS", "")
-        if "xla_tpu_enable_async_collective_fusion" not in flags:
+        # only on a TPU runtime: CPU-only jaxlib aborts on unknown tpu flags
+        if (looks_like_tpu_environment(env)
+                and "xla_tpu_enable_async_collective_fusion" not in flags):
             env["XLA_FLAGS"] = (RECOMMENDED_TPU_XLA_FLAGS + " " + flags).strip()
     return env
 

@@ -52,13 +52,24 @@ def apply_recommended_xla_flags() -> bool:
     """Prepend the TPU overlap flags to ``XLA_FLAGS`` (idempotent).
 
     Must run before the JAX backend initializes; returns False (no-op) when
-    the flags are already present.
+    the flags are already present.  CAUTION: only call when a TPU runtime
+    will actually parse them — a CPU-only jaxlib fatally aborts on unknown
+    ``--xla_tpu_*`` flags (``parse_flags_from_env.cc`` check failure).
     """
     current = os.environ.get("XLA_FLAGS", "")
     if "xla_tpu_enable_async_collective_fusion" in current:
         return False
     os.environ["XLA_FLAGS"] = (RECOMMENDED_TPU_XLA_FLAGS + " " + current).strip()
     return True
+
+
+def looks_like_tpu_environment(env=None) -> bool:
+    """Heuristic: will this process (or its children) run on a TPU runtime?"""
+    e = os.environ if env is None else env
+    if "tpu" in e.get("JAX_PLATFORMS", "").lower():
+        return True
+    return bool(e.get("TPU_WORKER_HOSTNAMES") or e.get("TPU_ACCELERATOR_TYPE")
+                or e.get("MEGASCALE_COORDINATOR_ADDRESS"))
 
 
 def setup_logging() -> None:

@@ -48,3 +48,27 @@ def test_capacity_drops_are_zero(cpu_devices):
     for d in range(E):
         np.testing.assert_allclose(out[d, :cap], np.ones((cap, D)), rtol=1e-6)
         np.testing.assert_allclose(out[d, cap:], np.zeros((T - cap, D)))
+
+
+def test_expert_fn_receives_flat_matrix(cpu_devices):
+    """The expert_fn contract is a 2-D [n_src * capacity, D] matrix —
+    a real FFN (einsum over D) must work."""
+    mesh = Mesh(np.array(cpu_devices[:E]), ("expert",))
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.normal(size=(E, T, D)), jnp.float32)
+    idx = jnp.asarray(rng.integers(0, E, size=(E, T)), jnp.int32)
+    w = jnp.eye(D) * 2.0
+
+    def f(xb, ib):
+        def expert_fn(p, tokens):
+            assert tokens.ndim == 2
+            return jnp.einsum("td,dh->th", tokens, p)
+
+        return moe_apply(xb[0], ib[0], expert_fn, w,
+                         capacity=T, axis="expert")[None]
+
+    fn = jax.jit(jax.shard_map(
+        f, mesh=mesh, in_specs=(P("expert"), P("expert")),
+        out_specs=P("expert")))
+    out = np.asarray(fn(x, idx))
+    np.testing.assert_allclose(out, np.asarray(x) * 2.0, rtol=1e-6)

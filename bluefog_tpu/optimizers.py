@@ -264,49 +264,61 @@ def win_put_optimizer(
     *,
     axis: Axis = "rank",
     num_steps_per_communication: int = 1,
+    fuse: bool = True,
 ) -> DecentralizedOptimizer:
     """Mailbox gossip: put params to out-neighbors, combine mailboxes, adapt.
 
     Reference: ``DistributedWinPutOptimizer`` (``optimizers.py:850-1005``).
-    The per-parameter window state (one mailbox per in-neighbor) is carried in
+    The window state (one mailbox per in-neighbor) is carried in
     ``comm_state``; staleness is exactly one step — a rank combines the values
     its neighbors put *last* step, matching the reference's nonblocking-put
-    pipeline.
+    pipeline.  ``fuse`` keeps one window per dtype buffer instead of one per
+    parameter (the reference creates a window per parameter and pays one RMA
+    epoch each; here fusing makes the put one permute chain total).
     """
     k = num_steps_per_communication
 
     def _sched():
         return sched if sched is not None else _mesh.static_schedule()
 
+    def _fused(params):
+        return fusion.fuse_tree(params).buffers if fuse else params
+
     def init(params):
         windows = jax.tree.map(
-            lambda x: wops.win_create(x, _sched(), zero_init=False), params)
+            lambda x: wops.win_create(x, _sched(), zero_init=False),
+            _fused(params))
         return DecentralizedState(
             jnp.zeros((), jnp.int32), opt.init(params), windows)
 
     def update(grads, state, params):
         s = _sched()
+        ft = fusion.fuse_tree(params) if fuse else None
+        comm_input = ft.buffers if fuse else params
 
         def communicate(operand):
-            params, windows = operand
+            values, windows = operand
 
             def leaf(w, x):
-                # combine last step's mailboxes with the current params,
+                # combine last step's mailboxes with the current value,
                 # then put the combined value to out-neighbors
                 w = wops.Window(value=x, recv=w.recv)
                 value, w = wops.win_update(w, s, axis=axis)
                 return wops.win_put(w, value, s, axis=axis)
 
-            new_windows = _map_windows(leaf, windows, params)
+            new_windows = _map_windows(leaf, windows, values)
             combined = _map_windows(lambda w: w.value, new_windows)
             return combined, new_windows
 
         if k > 1:
             combined, windows = lax.cond(
                 (state.step + 1) % k == 0, communicate,
-                lambda o: o, (params, state.comm_state))
+                lambda o: o, (comm_input, state.comm_state))
         else:
-            combined, windows = communicate((params, state.comm_state))
+            combined, windows = communicate((comm_input, state.comm_state))
+        if fuse:
+            ft.buffers = combined
+            combined = ft.unfuse()
         new_params, opt_state = _apply(opt, grads, state.opt_state, combined)
         return new_params, DecentralizedState(state.step + 1, opt_state, windows)
 
@@ -320,6 +332,7 @@ def push_sum(
     axis: Axis = "rank",
     self_weight: Optional[float] = None,
     dst_weight: Optional[float] = None,
+    fuse: bool = True,
 ) -> DecentralizedOptimizer:
     """Stochastic gradient push (push-sum gossip with weight correction).
 
@@ -333,13 +346,16 @@ def push_sum(
     def _sched():
         return sched if sched is not None else _mesh.static_schedule()
 
+    def _vals(params):
+        return fusion.fuse_tree(params).buffers if fuse else params
+
     def init(params):
         s = _sched()
         windows = jax.tree.map(
-            lambda x: wops.win_create(x, s, zero_init=True), params)
+            lambda x: wops.win_create(x, s, zero_init=True), _vals(params))
         p_windows = jax.tree.map(
             lambda x: wops.win_create(jnp.ones((), x.dtype), s, zero_init=True),
-            params)
+            _vals(params))
         return DecentralizedState(
             jnp.zeros((), jnp.int32), opt.init(params), (windows, p_windows))
 
@@ -350,6 +366,7 @@ def push_sum(
         sw = (1.0 / (out_deg + 1.0)) if self_weight is None else self_weight
         dw = sw if dst_weight is None else dst_weight
         windows, p_windows = state.comm_state
+        recipe = fusion.fuse_tree(params) if fuse else None
 
         def gossip(w):
             # accumulate dw*x into out-neighbors; then x' = sw*x + mailboxes
@@ -369,8 +386,13 @@ def push_sum(
         # channel so the mass-preserving invariant sum_r x_r = sum_r p_r*z_r
         # continues to hold (reference: optimizers.py:1140-1158)
         debiased = jax.tree.map(lambda x, p: x / p, mixed, p_new)
+        if fuse:
+            recipe.buffers = debiased
+            debiased = recipe.unfuse()
         new_params, opt_state = _apply(opt, grads, state.opt_state, debiased)
-        rebiased = jax.tree.map(lambda x, p: x * p, new_params, p_new)
+        adapted = (fusion.fuse_tree(new_params).buffers if fuse
+                   else new_params)
+        rebiased = jax.tree.map(lambda x, p: x * p, adapted, p_new)
         windows = _map_windows(
             lambda w, x: wops.Window(value=x, recv=w.recv), windows, rebiased)
         return new_params, DecentralizedState(

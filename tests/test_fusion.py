@@ -117,3 +117,50 @@ def test_fused_dynamic_schedules():
         for a, b in zip(jax.tree.leaves(results[False]),
                         jax.tree.leaves(results[True])):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
+
+def test_win_put_optimizer_fused_matches_unfused():
+    def grad_fn(params, batch):
+        return jax.value_and_grad(
+            lambda p: jnp.mean((p["a"] - batch) ** 2)
+            + jnp.mean(p["b"] ** 2))(params)
+
+    rng = np.random.default_rng(5)
+    params0 = {"a": jnp.asarray(rng.normal(size=(N, 1, 4)), jnp.float32),
+               "b": jnp.asarray(rng.normal(size=(N, 1, 2)), jnp.float32)}
+    target = jnp.ones((N, 1, 4))
+    results = {}
+    for fuse in (False, True):
+        strategy = bfopt.win_put_optimizer(optax.sgd(0.1), fuse=fuse)
+        dp = jax.tree.map(lambda x: x, params0)
+        ds = bfopt.init_distributed(strategy, dp)
+        step = bfopt.make_train_step(grad_fn, strategy)
+        for _ in range(4):
+            dp, ds, loss = step(dp, ds, target)
+            jax.block_until_ready(loss)
+        results[fuse] = dp
+    for a, b in zip(jax.tree.leaves(results[False]), jax.tree.leaves(results[True])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
+
+def test_push_sum_fused_matches_unfused():
+    def grad_fn(params, batch):
+        return jax.value_and_grad(
+            lambda p: jnp.mean((p["a"] - batch) ** 2))(params)
+
+    rng = np.random.default_rng(6)
+    params0 = {"a": jnp.asarray(rng.normal(size=(N, 1, 4)), jnp.float32),
+               "b": jnp.asarray(rng.normal(size=(N, 1, 2)), jnp.float32)}
+    target = jnp.zeros((N, 1, 4))
+    results = {}
+    for fuse in (False, True):
+        strategy = bfopt.push_sum(optax.sgd(0.05), fuse=fuse)
+        dp = jax.tree.map(lambda x: x, params0)
+        ds = bfopt.init_distributed(strategy, dp)
+        step = bfopt.make_train_step(grad_fn, strategy)
+        for _ in range(4):
+            dp, ds, loss = step(dp, ds, target)
+            jax.block_until_ready(loss)
+        results[fuse] = dp
+    for a, b in zip(jax.tree.leaves(results[False]), jax.tree.leaves(results[True])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5)

@@ -73,6 +73,9 @@ def neighbor_communicator(
     """
     if (schedule is None) == (schedules is None):
         raise ValueError("pass exactly one of schedule / schedules")
+    if schedule is not None and schedule.num_rounds == 0:
+        fuse = False     # degenerate topology (e.g. 1 chip): the op is
+                         # elementwise, fusion's concat/split is pure cost
 
     def comm(params, step):
         def leaf(x):

@@ -42,7 +42,7 @@ from .parallel import (
 )
 from .api import (
     allreduce, allgather, ragged_allgather, broadcast,
-    neighbor_allreduce, neighbor_allgather,
+    neighbor_allreduce, neighbor_allgather, ragged_neighbor_allgather,
     pair_gossip, hierarchical_neighbor_allreduce,
     barrier, synchronize, poll, resolve_schedule, shard_distributed,
 )

@@ -30,7 +30,8 @@ from .schedule import CommSchedule, compile_from_weights
 
 __all__ = [
     "allreduce", "allgather", "ragged_allgather", "broadcast",
-    "neighbor_allreduce", "neighbor_allgather", "pair_gossip",
+    "neighbor_allreduce", "neighbor_allgather", "ragged_neighbor_allgather",
+    "pair_gossip",
     "hierarchical_neighbor_allreduce",
     "barrier", "synchronize", "poll", "resolve_schedule", "shard_distributed",
 ]
@@ -190,6 +191,37 @@ def neighbor_allgather(
             _per_rank(partial(ops.neighbor_allgather, sched=sched, axis="rank")),
             ctx.mesh))
     return fn(x)
+
+
+def ragged_neighbor_allgather(
+    x: jax.Array,
+    lengths,
+    *,
+    self_weight=None,
+    src_weights=None,
+    dst_weights=None,
+    schedule: Optional[CommSchedule] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Neighbor allgather of per-rank slices with different valid first dims.
+
+    Same pad + length-channel contract as :func:`ragged_allgather` (the
+    reference's neighbor_allgather handles varying first dimensions via size
+    pre-negotiation, ``mpi_context.cc:504-630``): ``x`` is ``[n, max_d0,
+    ...]`` with rank r's valid rows in ``x[r, :lengths[r]]``.  Returns
+    ``(gathered [n, max_in_degree * max_d0, ...], lengths [n,
+    max_in_degree])`` where slot k of rank r holds the padded slice and valid
+    length of its k-th sorted in-neighbor.
+    """
+    ctx = _mesh.get_context()
+    _check_distributed(x, ctx.size)
+    lengths = jnp.asarray(lengths, jnp.int32).reshape(ctx.size, 1)
+    gathered = neighbor_allgather(
+        x, self_weight=self_weight, src_weights=src_weights,
+        dst_weights=dst_weights, schedule=schedule)
+    glens = neighbor_allgather(
+        lengths, self_weight=self_weight, src_weights=src_weights,
+        dst_weights=dst_weights, schedule=schedule)
+    return gathered, glens.reshape(ctx.size, -1)
 
 
 def allreduce(x: jax.Array, average: bool = True) -> jax.Array:

@@ -248,3 +248,22 @@ def test_ragged_allgather():
         for s in range(N):
             valid = np.asarray(g[r, s * max_d0: s * max_d0 + got_lens[s]])
             np.testing.assert_array_equal(valid, np.full(valid.shape, s))
+
+
+def test_ragged_neighbor_allgather():
+    """Variable-first-dim neighbor gather (reference: size pre-negotiation,
+    mpi_context.cc:504-630)."""
+    bf.set_topology(tu.RingGraph(N, connect_style=0))
+    max_d0 = 3
+    lengths = np.array([r % max_d0 + 1 for r in range(N)])
+    x = np.zeros((N, max_d0, 1), np.float32)
+    for r in range(N):
+        x[r, :lengths[r]] = r
+    g, glens = bf.ragged_neighbor_allgather(jnp.asarray(x), lengths)
+    assert g.shape == (N, 2 * max_d0, 1)
+    for r in range(N):
+        nbrs = tu.GetInNeighbors(tu.RingGraph(N, connect_style=0), r)
+        np.testing.assert_array_equal(np.asarray(glens[r]), lengths[nbrs])
+        for k, s in enumerate(nbrs):
+            valid = np.asarray(g[r, k * max_d0: k * max_d0 + lengths[s]])
+            np.testing.assert_array_equal(valid, np.full(valid.shape, s))

@@ -88,28 +88,68 @@ def ring_attention(
     blk_q, blk_k = q.shape[1], k.shape[1]
 
     if use_pallas:
-        from . import pallas_attention as pa
-        perm_p = _ring_perm(n, 1)
-        o0 = lax.pcast(jnp.zeros(q.shape, jnp.float32), axis, to='varying')
-        l0 = lax.pcast(jnp.zeros(q.shape[:3], jnp.float32), axis, to='varying')
-        m0 = lax.pcast(
-            jnp.full(q.shape[:3], -jnp.inf, jnp.float32), axis, to='varying')
+        return _pallas_ring_attention(q, k, v, axis, causal, float(scale))
+    return _jnp_ring_attention(q, k, v, axis, causal, float(scale))
 
-        def pstep(carry, t):
-            o, l, m, kt, vt = carry
-            src = (idx - t) % n
-            part = pa.attention_block_partial(
-                q, kt, vt, idx * blk_q, src * blk_k,
-                causal=causal, scale=scale)
-            o, l, m = pa.merge_partials((o, l, m), part)
-            kt = lax.ppermute(kt, axis, perm=perm_p)
-            vt = lax.ppermute(vt, axis, perm=perm_p)
-            return (o, l, m, kt, vt), None
 
-        (o, l, _, _, _), _ = lax.scan(pstep, (o0, l0, m0, k, v), jnp.arange(n))
-        l = jnp.where(l == 0.0, 1.0, l)
-        return (o / l[..., None]).astype(q.dtype)
+def _pallas_forward(q, k, v, axis: Axis, causal: bool, scale: float):
+    from . import pallas_attention as pa
+    n = lax.axis_size(axis)
+    idx = lax.axis_index(axis)
+    blk_q, blk_k = q.shape[1], k.shape[1]
+    perm_p = _ring_perm(n, 1)
+    o0 = lax.pcast(jnp.zeros(q.shape, jnp.float32), axis, to='varying')
+    l0 = lax.pcast(jnp.zeros(q.shape[:3], jnp.float32), axis, to='varying')
+    m0 = lax.pcast(
+        jnp.full(q.shape[:3], -jnp.inf, jnp.float32), axis, to='varying')
 
+    def pstep(carry, t):
+        o, l, m, kt, vt = carry
+        src = (idx - t) % n
+        part = pa.attention_block_partial(
+            q, kt, vt, idx * blk_q, src * blk_k,
+            causal=causal, scale=scale)
+        o, l, m = pa.merge_partials((o, l, m), part)
+        kt = lax.ppermute(kt, axis, perm=perm_p)
+        vt = lax.ppermute(vt, axis, perm=perm_p)
+        return (o, l, m, kt, vt), None
+
+    (o, l, _, _, _), _ = lax.scan(pstep, (o0, l0, m0, k, v), jnp.arange(n))
+    l = jnp.where(l == 0.0, 1.0, l)
+    return (o / l[..., None]).astype(q.dtype)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _pallas_ring_attention(q, k, v, axis: Axis, causal: bool, scale: float):
+    """Pallas forward with a recompute backward.
+
+    The kernel has no VJP rule, so the backward differentiates the pure-jnp
+    ring path instead (mathematically the same function): forward keeps the
+    score matrix in VMEM; backward recomputes blockwise in jnp — standard
+    flash-attention recompute, paid only when training.
+    """
+    return _pallas_forward(q, k, v, axis, causal, scale)
+
+
+def _pallas_ring_fwd(q, k, v, axis, causal, scale):
+    return _pallas_forward(q, k, v, axis, causal, scale), (q, k, v)
+
+
+def _pallas_ring_bwd(axis, causal, scale, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: _jnp_ring_attention(q_, k_, v_, axis, causal, scale),
+        q, k, v)
+    return vjp(g)
+
+
+_pallas_ring_attention.defvjp(_pallas_ring_fwd, _pallas_ring_bwd)
+
+
+def _jnp_ring_attention(q, k, v, axis: Axis, causal: bool, scale: float):
+    n = lax.axis_size(axis)
+    idx = lax.axis_index(axis)
+    blk_q, blk_k = q.shape[1], k.shape[1]
     qf = q.astype(jnp.float32) * scale
     perm = _ring_perm(n, 1)
 

@@ -1,0 +1,110 @@
+"""Long-context LM training: ring-attention sequence parallelism end-to-end.
+
+The capability the reference's architecture points toward but predates
+(SURVEY.md §5 "long-context"): the sequence is SHARDED across the mesh —
+each device holds ``seq_len / n`` tokens — and exact causal attention runs
+by rotating K/V blocks around the ring with the same ``ppermute`` primitive
+the gossip layer uses.  Device memory per layer stays O((seq/n)^2) while the
+context length scales linearly with the mesh.
+
+A tiny copy-task language model (predict the token 8 positions back) trains
+to low loss, proving gradients flow correctly through the ring.
+
+Run: python examples/long_context.py --virtual-cpu --steps 30
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--virtual-cpu", action="store_true")
+    parser.add_argument("--seq-len", type=int, default=256,
+                        help="global sequence length (sharded over devices)")
+    parser.add_argument("--steps", type=int, default=50)
+    parser.add_argument("--lag", type=int, default=8,
+                        help="copy-task distance (tests cross-device attention "
+                             "when > seq_len / n)")
+    parser.add_argument("--d-model", type=int, default=64)
+    parser.add_argument("--lr", type=float, default=3e-3)
+    parser.add_argument("--seed", type=int, default=0)
+    args = parser.parse_args()
+
+    if args.virtual_cpu:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8").strip()
+
+    import jax
+    if args.virtual_cpu:
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax.sharding import PartitionSpec as P
+    import bluefog_tpu as bf
+    from bluefog_tpu import models
+
+    bf.init(platform="cpu" if args.virtual_cpu else None)
+    n = bf.size()
+    T = args.seq_len
+    assert T % n == 0, "seq-len must divide the device count"
+    local_T = T // n
+    vocab = 32
+
+    lm = models.RingTransformerLM(
+        vocab_size=vocab, num_layers=2, num_heads=2, d_model=args.d_model,
+        max_seq_len=T, axis="rank", dtype=jnp.float32)
+    params = lm.clone(axis=None).init(
+        jax.random.key(args.seed), jnp.zeros((1, local_T), jnp.int32))
+
+    opt = optax.adam(args.lr)
+    opt_state = opt.init(params)
+
+    def step_fn(params, opt_state, tokens, targets):
+        idx = jax.lax.axis_index("rank")
+
+        def loss_fn(p):
+            logits = lm.apply(p, tokens, pos_offset=idx * local_T)
+            mask = (targets >= 0).astype(jnp.float32)
+            ce = optax.softmax_cross_entropy_with_integer_labels(
+                logits, jnp.maximum(targets, 0))
+            return jnp.sum(ce * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        # replicated params, sequence-sharded loss: sum grads over the ring
+        grads = jax.tree.map(lambda g: jax.lax.psum(g, "rank"), grads)
+        loss = jax.lax.pmean(loss, "rank")
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    train = jax.jit(jax.shard_map(
+        step_fn, mesh=bf.mesh(),
+        in_specs=(P(), P(), P(None, "rank"), P(None, "rank")),
+        out_specs=(P(), P(), P())))
+
+    rng = np.random.default_rng(args.seed)
+    losses = []
+    for it in range(args.steps):
+        seq = rng.integers(0, vocab, size=(1, T))
+        targets = np.full((1, T), -1, np.int64)
+        targets[:, args.lag:] = seq[:, :-args.lag]     # predict token lag back
+        params, opt_state, loss = train(
+            params, opt_state, jnp.asarray(seq, jnp.int32),
+            jnp.asarray(targets, jnp.int32))
+        losses.append(float(jax.block_until_ready(loss)))
+        if it % 10 == 0 or it == args.steps - 1:
+            print(f"step {it}: loss {losses[-1]:.4f} "
+                  f"(seq {T} over {n} devices, {local_T}/device)")
+
+    assert losses[-1] < losses[0], "no training progress through the ring"
+    print(f"[ring-SP] loss {losses[0]:.3f} -> {losses[-1]:.3f} on "
+          f"{T}-token context sharded {n} ways")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,122 @@
+"""Mixture-of-experts training: expert parallelism end-to-end.
+
+Each device on the ``expert`` mesh axis owns one expert FFN; a replicated
+router picks an expert per token and :func:`bluefog_tpu.parallel.expert.
+moe_apply` moves tokens to their expert's device and back with two
+``all_to_all``s.  Gradient semantics under SPMD: router gradients are
+psum'd over the expert axis (replicated parameters), expert gradients stay
+local (each device owns different parameters) — the exact split megascale
+MoE training uses.
+
+The task is expert-friendly by construction (piecewise-linear regression:
+each input cluster has its own linear map), so training only converges if
+routing + dispatch + return all work.
+
+Run: python examples/moe.py --virtual-cpu --steps 60
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--virtual-cpu", action="store_true")
+    parser.add_argument("--num-experts", type=int, default=4)
+    parser.add_argument("--tokens", type=int, default=64)
+    parser.add_argument("--dim", type=int, default=8)
+    parser.add_argument("--steps", type=int, default=80)
+    parser.add_argument("--lr", type=float, default=0.05)
+    parser.add_argument("--seed", type=int, default=0)
+    args = parser.parse_args()
+
+    if args.virtual_cpu:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8").strip()
+
+    import jax
+    if args.virtual_cpu:
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax.sharding import Mesh, PartitionSpec as P
+    import bluefog_tpu as bf
+    from bluefog_tpu.parallel.expert import moe_apply
+
+    bf.init(platform="cpu" if args.virtual_cpu else None)
+    E, D, T = args.num_experts, args.dim, args.tokens
+    devices = np.asarray(bf.devices())[:E]
+    mesh = Mesh(devices, ("expert",))
+
+    rng = np.random.default_rng(args.seed)
+    # ground truth: cluster c lives at center_c, mapped by its own matrix
+    centers = rng.normal(size=(E, D)) * 4.0
+    true_maps = rng.normal(size=(E, D, D))
+
+    def sample_batch():
+        c = rng.integers(0, E, size=T)
+        x = centers[c] + rng.normal(size=(T, D)) * 0.3
+        y = np.einsum("td,tdh->th", x, true_maps[c])
+        return (jnp.asarray(x, jnp.float32), jnp.asarray(y, jnp.float32))
+
+    params = {
+        "router": jnp.asarray(rng.normal(size=(D, E)) * 0.1, jnp.float32),
+        # expert e's map lives on device e: [E, D, D] sharded over the axis
+        "expert": jnp.asarray(rng.normal(size=(E, D, D)) * 0.1, jnp.float32),
+    }
+    opt = optax.adam(args.lr)
+    opt_state = opt.init(params)
+    capacity = T  # demo: no drops, correctness first
+
+    pspec = {"router": P(), "expert": P("expert")}
+
+    def grad_step(params, x, y):
+        def loss_fn(p):
+            logits = x @ p["router"]                      # [T, E] replicated
+            idx = jnp.argmax(logits, axis=-1)
+            gate = jax.nn.softmax(logits)[jnp.arange(T), idx]
+
+            def expert_fn(w, tokens):                     # w: [1, D, D] local
+                return tokens @ w[0]
+
+            out = moe_apply(x, idx, expert_fn, p["expert"],
+                            capacity=capacity, axis="expert")
+            pred = out * gate[:, None]
+            return jnp.mean((pred - y) ** 2)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        # replicated router: reduce over the axis; per-device experts: local
+        grads = {"router": jax.lax.pmean(grads["router"], "expert"),
+                 "expert": grads["expert"]}
+        return jax.lax.pmean(loss, "expert"), grads
+
+    sharded_grads = jax.jit(jax.shard_map(
+        grad_step, mesh=mesh,
+        in_specs=(pspec, P(), P()), out_specs=(P(), pspec)))
+
+    @jax.jit
+    def apply_update(params, opt_state, grads):
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state
+
+    losses = []
+    for it in range(args.steps):
+        x, y = sample_batch()
+        loss, grads = sharded_grads(params, x, y)
+        params, opt_state = apply_update(params, opt_state, grads)
+        losses.append(float(jax.block_until_ready(loss)))
+        if it % 20 == 0 or it == args.steps - 1:
+            print(f"step {it}: loss {losses[-1]:.4f}")
+
+    assert losses[-1] < losses[0] * 0.5, "MoE did not train"
+    print(f"[moe] {E} experts on {E} devices: loss "
+          f"{losses[0]:.3f} -> {losses[-1]:.3f}")
+
+
+if __name__ == "__main__":
+    main()

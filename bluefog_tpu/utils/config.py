@@ -64,12 +64,21 @@ def apply_recommended_xla_flags() -> bool:
 
 
 def looks_like_tpu_environment(env=None) -> bool:
-    """Heuristic: will this process (or its children) run on a TPU runtime?"""
+    """Heuristic: will this process (or its children) parse TPU XLA flags?
+
+    Deliberately conservative: tunnel-style plugins (axon) set TPU_* env vars
+    but run a CPU-only local jaxlib that fatally aborts on the flags, so
+    their presence (PALLAS_AXON_POOL_IPS) vetoes.  A real pod worker has
+    multi-host TPU_WORKER_HOSTNAMES or megascale coordination.
+    """
     e = os.environ if env is None else env
+    if e.get("PALLAS_AXON_POOL_IPS"):
+        return False
     if "tpu" in e.get("JAX_PLATFORMS", "").lower():
         return True
-    return bool(e.get("TPU_WORKER_HOSTNAMES") or e.get("TPU_ACCELERATOR_TYPE")
-                or e.get("MEGASCALE_COORDINATOR_ADDRESS"))
+    hostnames = e.get("TPU_WORKER_HOSTNAMES", "")
+    multi_host = len(hostnames.split(",")) > 1 and hostnames != "localhost"
+    return bool(multi_host or e.get("MEGASCALE_COORDINATOR_ADDRESS"))
 
 
 def setup_logging() -> None:

@@ -10,6 +10,7 @@ optimizer strategies for gossip-DP x ring-SP 2-D parallel training.
 from typing import Any, Optional
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 from ..ops import ring_attention
@@ -68,6 +69,7 @@ class RingTransformerLM(nn.Module):
     max_seq_len: int = 8192
     axis: Optional[str] = None
     dtype: Any = jnp.bfloat16
+    remat: bool = False     # rematerialize blocks: trade FLOPs for HBM
 
     @nn.compact
     def __call__(self, tokens, pos_offset=0):
@@ -77,8 +79,11 @@ class RingTransformerLM(nn.Module):
         pos = nn.Embed(self.max_seq_len, self.d_model, dtype=self.dtype)(
             pos_offset + jnp.arange(T))
         x = x + pos[None]
+        Block = (nn.remat(RingTransformerBlock,
+                          policy=jax.checkpoint_policies.nothing_saveable)
+                 if self.remat else RingTransformerBlock)
         for _ in range(self.num_layers):
-            x = RingTransformerBlock(
+            x = Block(
                 num_heads=self.num_heads, axis=self.axis, dtype=self.dtype)(x)
         x = nn.LayerNorm(dtype=jnp.float32)(x)
         return nn.Dense(self.vocab_size, use_bias=False,

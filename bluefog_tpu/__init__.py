@@ -19,6 +19,7 @@ from . import ops
 from . import optimizers
 from . import fusion
 from . import checkpoint
+from . import data
 from . import utils
 from .utils import (
     timeline_start_activity, timeline_end_activity, timeline_context,

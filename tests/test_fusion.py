@@ -164,3 +164,34 @@ def test_push_sum_fused_matches_unfused():
         results[fuse] = dp
     for a, b in zip(jax.tree.leaves(results[False]), jax.tree.leaves(results[True])):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5)
+
+
+def test_fused_dst_weighted_schedule():
+    """Fusion x dst-weighting (reference torch_ops_test.py:905-1115)."""
+    from bluefog_tpu.schedule import compile_from_weights
+    sched = compile_from_weights(
+        N, [0.5] * N,
+        [{(r - 1) % N: 0.5} for r in range(N)],
+        [{(r + 1) % N: 2.0} for r in range(N)])
+    assert sched.uses_dst_weighting
+    rng = np.random.default_rng(9)
+    dist = {"a": jnp.asarray(rng.normal(size=(N, 1, 6)), jnp.float32),
+            "b": jnp.asarray(rng.normal(size=(N, 1, 3)), jnp.float32)}
+    from jax.sharding import PartitionSpec as P
+    results = {}
+    for fuse in (False, True):
+        comm = bfopt.neighbor_communicator(sched, fuse=fuse)
+        fn = jax.jit(jax.shard_map(
+            lambda t: jax.tree.map(
+                lambda x: x[None],
+                comm(jax.tree.map(lambda x: x[0], t), jnp.zeros((), jnp.int32))),
+            mesh=bf.mesh(), in_specs=P("rank"), out_specs=P("rank")))
+        results[fuse] = fn(dist)
+    for a, b in zip(jax.tree.leaves(results[False]), jax.tree.leaves(results[True])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+    # oracle: x' = 0.5 x + 0.5 * (2.0 * x_prev)
+    vals = np.asarray(dist["a"])
+    for r in range(N):
+        expected = 0.5 * vals[r] + 1.0 * vals[(r - 1) % N]
+        np.testing.assert_allclose(
+            np.asarray(results[True]["a"][r]), expected, rtol=1e-5)

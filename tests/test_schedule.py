@@ -93,3 +93,55 @@ def test_schedule_hash_stable():
     c = sch.compile_topology(tu.RingGraph(8))
     assert a == b and hash(a) == hash(b)
     assert a != c
+
+
+def test_random_digraph_coloring_properties():
+    """Arbitrary digraphs: every round is a partial permutation, every edge
+    appears exactly once, and rounds <= 2*max_degree - 1 (greedy interval
+    bound)."""
+    rng = np.random.default_rng(0)
+    for trial in range(20):
+        n = int(rng.integers(2, 24))
+        density = rng.uniform(0.05, 0.6)
+        edges = [(int(u), int(v)) for u in range(n) for v in range(n)
+                 if u != v and rng.random() < density]
+        if not edges:
+            continue
+        rounds = sch.color_edges(edges, n)
+        flat = [e for r in rounds for e in r]
+        assert sorted(flat) == sorted(set(edges))
+        for r in rounds:
+            assert len({e[0] for e in r}) == len(r)     # distinct senders
+            assert len({e[1] for e in r}) == len(r)     # distinct receivers
+        out_deg = np.zeros(n, int); in_deg = np.zeros(n, int)
+        for u, v in set(edges):
+            out_deg[u] += 1; in_deg[v] += 1
+        max_deg = max(out_deg.max(), in_deg.max())
+        assert len(rounds) <= 2 * max_deg - 1
+
+
+@pytest.mark.parametrize("n", [1, 2, 3, 5, 6, 7, 12])
+def test_compile_topology_odd_sizes(n):
+    """Generators + compiler handle non-power-of-2 and tiny sizes."""
+    for make in (tu.ExponentialTwoGraph, tu.RingGraph, tu.FullyConnectedGraph):
+        if n == 1:
+            continue
+        topo = make(n)
+        s = sch.compile_topology(topo, weighted=True)
+        W = tu.to_weight_matrix(topo)
+        # reconstruct the mixing matrix from the compiled tables
+        M = np.zeros((n, n))
+        for dst in range(n):
+            M[dst, dst] = s.self_weight[dst]
+        for r in range(s.num_rounds):
+            for dst in range(n):
+                src = s.recv_src[r, dst]
+                if src >= 0:
+                    M[src, dst] += s.recv_weight[r, dst]
+        np.testing.assert_allclose(M, W, atol=1e-6)
+
+
+def test_compile_topology_size_one():
+    topo = tu.FullyConnectedGraph(1)
+    s = sch.compile_topology(topo, weighted=True)
+    assert s.num_rounds == 0 and s.self_weight[0] == 1.0

@@ -17,7 +17,7 @@ reference's handle semantics (``mpi_ops.py:962-1005``) without a handle table.
 from __future__ import annotations
 
 from functools import partial
-from typing import Dict, List, Optional, Sequence, Union
+from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp

@@ -54,8 +54,17 @@ __all__ += [
     "turn_on_win_ops_with_associated_p", "turn_off_win_ops_with_associated_p",
 ]
 
-from . import tensor_parallel
 from . import pipeline
 from . import expert
 
 __all__ += ["tensor_parallel", "pipeline", "expert"]
+
+
+def __getattr__(name):
+    # lazy: tensor_parallel pulls in flax, which is an optional extra
+    if name == "tensor_parallel":
+        import importlib
+        mod = importlib.import_module(".tensor_parallel", __name__)
+        globals()["tensor_parallel"] = mod
+        return mod
+    raise AttributeError(name)

@@ -114,10 +114,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                 "BLUEFOG_PROCESS_ID": str(pid),
             })
             procs.append(subprocess.Popen(cmd, env=penv))
-        rc = 0
-        for p in procs:
-            rc = rc or p.wait()
-        return rc
+        codes = [p.wait() for p in procs]   # wait on ALL before deciding
+        return next((c for c in codes if c), 0)
 
     if args.coordinator:
         if (args.num_processes or 1) > 1 and args.process_id is None:

@@ -16,24 +16,32 @@ from ..parallel import context as _mesh
 from .. import ops
 
 
-def _lift(op):
+_jit_cache = {}
+
+
+def _lift(op_key, op):
     def fn(tree):
         ctx = _mesh.get_context()
-        f = jax.jit(jax.shard_map(
-            lambda t: jax.tree.map(lambda x: op(x[0])[None], t),
-            mesh=ctx.mesh, in_specs=P("rank"), out_specs=P("rank")))
+        key = (op_key, ctx.mesh, jax.tree.structure(tree),
+               tuple((jnp.shape(l), jnp.asarray(l).dtype.name)
+                     for l in jax.tree.leaves(tree)))
+        f = _jit_cache.get(key)
+        if f is None:
+            f = _jit_cache[key] = jax.jit(jax.shard_map(
+                lambda t: jax.tree.map(lambda x: op(x[0])[None], t),
+                mesh=ctx.mesh, in_specs=P("rank"), out_specs=P("rank")))
         return f(tree)
     return fn
 
 
 def broadcast_parameters(params: Any, root_rank: int = 0) -> Any:
     """Every rank's slice becomes root's (reference: ``utility.py:26-56``)."""
-    return _lift(lambda x: ops.broadcast(x, root_rank))(params)
+    return _lift(("bc", root_rank), lambda x: ops.broadcast(x, root_rank))(params)
 
 
 def allreduce_parameters(params: Any) -> Any:
     """Average all ranks' slices in place (reference: ``utility.py:58-87``)."""
-    return _lift(lambda x: ops.allreduce(x, average=True))(params)
+    return _lift(("ar",), lambda x: ops.allreduce(x, average=True))(params)
 
 
 def broadcast_optimizer_state(opt_state: Any, root_rank: int = 0) -> Any:

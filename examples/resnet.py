@@ -114,8 +114,9 @@ def main():
 
         (loss, new_bs), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(train_state["params"])
-        # carry BN stats through the "gradient" channel as a delta so the
-        # optimizer pipeline stays purely functional
+        # BN running stats intentionally stay at init in this driver: the
+        # strategies optimize only what flows through the optax channel, and
+        # evaluation below normalizes with per-batch statistics instead
         return loss, {"params": grads,
                       "bs": jax.tree.map(jnp.zeros_like, new_bs)}
 
@@ -158,9 +159,11 @@ def main():
 
     @jax.jit
     def evaluate(p0):
-        logits = model.apply(
+        # per-batch statistics: running stats are not tracked (see grad_fn),
+        # so evaluating with them would normalize against init mean/var
+        logits, _ = model.apply(
             {"params": p0["params"], "batch_stats": p0["bs"]},
-            jnp.asarray(x_te), train=False)
+            jnp.asarray(x_te), train=True, mutable=["batch_stats"])
         return (jnp.argmax(logits, -1) == jnp.asarray(y_te)).mean()
 
     for epoch in range(start_epoch, args.epochs):

@@ -11,9 +11,11 @@ from .mlp import MLP
 from .cnn import MnistCNN
 from .resnet import ResNet, ResNet18, ResNet34, ResNet50
 from .transformer import RingTransformerBlock, RingTransformerLM
+from .vgg import VGG, VGG11, VGG16
 
 __all__ = [
     "MLP", "MnistCNN",
     "ResNet", "ResNet18", "ResNet34", "ResNet50",
     "RingTransformerBlock", "RingTransformerLM",
+    "VGG", "VGG11", "VGG16",
 ]

@@ -1,0 +1,45 @@
+"""VGG in flax.linen (reference: torchvision model selection in
+``examples/pytorch_benchmark.py:75-107`` — resnet/vgg/alexnet families).
+
+TPU-first: NHWC layout, bf16 conv compute with f32 classifier head; no
+local response norm (modern practice, matches torchvision's vgg16 w/o BN).
+"""
+from typing import Any, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+_CFG16 = (64, 64, "M", 128, 128, "M", 256, 256, 256, "M",
+          512, 512, 512, "M", 512, 512, 512, "M")
+_CFG11 = (64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M")
+
+
+class VGG(nn.Module):
+    cfg: Tuple = _CFG16
+    num_classes: int = 1000
+    hidden: int = 4096
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = True):
+        x = x.astype(self.dtype)
+        for c in self.cfg:
+            if c == "M":
+                x = nn.max_pool(x, (2, 2), strides=(2, 2))
+            else:
+                x = nn.Conv(c, (3, 3), padding="SAME", dtype=self.dtype)(x)
+                x = nn.relu(x)
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(self.hidden, dtype=self.dtype)(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.relu(nn.Dense(self.hidden, dtype=self.dtype)(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+
+
+def VGG16(**kw) -> VGG:
+    return VGG(cfg=_CFG16, **kw)
+
+
+def VGG11(**kw) -> VGG:
+    return VGG(cfg=_CFG11, **kw)

@@ -24,7 +24,7 @@ def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--virtual-cpu", action="store_true")
     parser.add_argument("--model", default="resnet50",
-                        choices=["resnet50", "resnet18", "cnn", "mlp"])
+                        choices=["resnet50", "resnet18", "vgg16", "vgg11", "cnn", "mlp"])
     parser.add_argument("--dist-optimizer", default="neighbor_allreduce",
                         choices=["neighbor_allreduce", "gradient_allreduce",
                                  "allreduce", "hierarchical_neighbor_allreduce",
@@ -70,6 +70,9 @@ def main():
         model, img = models.ResNet50(num_classes=1000), (224, 224, 3)
     elif args.model == "resnet18":
         model, img = models.ResNet18(num_classes=1000), (224, 224, 3)
+    elif args.model.startswith("vgg"):
+        Model = models.VGG16 if args.model == "vgg16" else models.VGG11
+        model, img = Model(num_classes=1000), (224, 224, 3)
     elif args.model == "cnn":
         model, img = models.MnistCNN(), (28, 28, 1)
     else:
@@ -79,7 +82,7 @@ def main():
     xb = jnp.ones((n, B) + img, jnp.float32)
     yb = jnp.zeros((n, B), jnp.int32)
     has_bn = args.model.startswith("resnet")
-    has_train_flag = has_bn or args.model == "cnn"
+    has_train_flag = has_bn or args.model in ("cnn",) or args.model.startswith("vgg")
     variables = (model.init(jax.random.key(0), xb[0], train=False)
                  if has_train_flag else model.init(jax.random.key(0), xb[0]))
 

@@ -63,3 +63,14 @@ def test_ring_attention_exact(mesh, causal):
     out = fn(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
     expected = _reference_attention(q, k, v, causal)
     np.testing.assert_allclose(np.asarray(out), expected, atol=2e-5)
+
+
+def test_vgg_forward_shapes():
+    import jax
+    import jax.numpy as jnp
+    from bluefog_tpu import models
+    m = models.VGG11(num_classes=10, hidden=64)
+    x = jnp.ones((2, 32, 32, 3), jnp.float32)
+    params = m.init(jax.random.key(0), x, train=False)
+    out = m.apply(params, x, train=False)
+    assert out.shape == (2, 10) and out.dtype == jnp.float32

@@ -22,6 +22,7 @@ class RingTransformerBlock(nn.Module):
     mlp_ratio: int = 4
     axis: Optional[str] = None          # mesh axis the sequence is sharded over
     dtype: Any = jnp.bfloat16
+    use_pallas: bool = False            # VMEM flash kernel for the attention
 
     @nn.compact
     def __call__(self, x):
@@ -35,7 +36,8 @@ class RingTransformerBlock(nn.Module):
         k = k.reshape(B, T, H, C // H)
         v = v.reshape(B, T, H, C // H)
         if self.axis is not None:
-            att = ring_attention(q, k, v, axis=self.axis, causal=True)
+            att = ring_attention(q, k, v, axis=self.axis, causal=True,
+                                 use_pallas=self.use_pallas)
         else:
             # single-device fallback: dense causal attention
             s = jnp.einsum("bihd,bjhd->bihj", q.astype(jnp.float32),
@@ -70,6 +72,7 @@ class RingTransformerLM(nn.Module):
     axis: Optional[str] = None
     dtype: Any = jnp.bfloat16
     remat: bool = False     # rematerialize blocks: trade FLOPs for HBM
+    use_pallas: bool = False
 
     @nn.compact
     def __call__(self, tokens, pos_offset=0):
@@ -84,7 +87,8 @@ class RingTransformerLM(nn.Module):
                  if self.remat else RingTransformerBlock)
         for _ in range(self.num_layers):
             x = Block(
-                num_heads=self.num_heads, axis=self.axis, dtype=self.dtype)(x)
+                num_heads=self.num_heads, axis=self.axis, dtype=self.dtype,
+                use_pallas=self.use_pallas)(x)
         x = nn.LayerNorm(dtype=jnp.float32)(x)
         return nn.Dense(self.vocab_size, use_bias=False,
                         dtype=jnp.float32)(x)

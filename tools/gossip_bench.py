@@ -1,0 +1,96 @@
+"""Gossip-step microbenchmark: schedule quality + per-step cost by topology.
+
+Prints, for each topology family at a given size: the number of compiled
+ppermute rounds (the latency chain), the bytes each chip moves per step
+relative to model size (the bandwidth cost), and the measured wall-clock per
+gossip step on the current backend.  The rounds/bytes columns are the
+hardware-independent quality of the schedule compiler; the ms column is
+backend-specific (virtual CPU mesh here, ICI on TPU).
+
+Run: python tools/gossip_bench.py --virtual-cpu --params 1048576
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--virtual-cpu", action="store_true")
+    parser.add_argument("--params", type=int, default=1 << 20,
+                        help="elements per rank in the gossip buffer")
+    parser.add_argument("--iters", type=int, default=10)
+    args = parser.parse_args()
+
+    if args.virtual_cpu:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8").strip()
+
+    import jax
+    if args.virtual_cpu:
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+    import bluefog_tpu as bf
+    from bluefog_tpu import schedule as sch
+    from bluefog_tpu import topology as tu
+
+    bf.init(platform="cpu" if args.virtual_cpu else None)
+    n = bf.size()
+
+    topologies = {
+        "ring": tu.RingGraph(n),
+        "expo2": tu.ExponentialTwoGraph(n),
+        "mesh2d": tu.MeshGrid2DGraph(n),
+        "star": tu.StarGraph(n),
+        "full": tu.FullyConnectedGraph(n),
+    }
+    dyn = sch.compile_dynamic_schedules(
+        lambda r: tu.GetDynamicOnePeerSendRecvRanks(
+            tu.ExponentialTwoGraph(n), r), n)
+
+    x = jnp.ones((n, args.params), jnp.float32)
+    rows = []
+
+    def measure(schedule):
+        fn = jax.jit(jax.shard_map(
+            lambda t: bf.ops.neighbor_allreduce(t[0], schedule)[None],
+            mesh=bf.mesh(), in_specs=P("rank"), out_specs=P("rank")))
+        out = jax.block_until_ready(fn(x))
+        t0 = time.perf_counter()
+        for _ in range(args.iters):
+            out = jax.block_until_ready(fn(out))
+        return (time.perf_counter() - t0) / args.iters * 1e3
+
+    for name, topo in topologies.items():
+        s = sch.compile_topology(topo, weighted=True)
+        send_deg = float(np.mean(s.out_degree))
+        rows.append((name, s.num_rounds, send_deg, measure(s)))
+    rows.append(("expo2-dynamic(1peer)", dyn[0].num_rounds,
+                 float(np.mean(dyn[0].out_degree)), measure(dyn[0])))
+    # the allreduce comparison line (Horovod-mode)
+    fn = jax.jit(jax.shard_map(
+        lambda t: bf.ops.allreduce(t[0])[None],
+        mesh=bf.mesh(), in_specs=P("rank"), out_specs=P("rank")))
+    out = jax.block_until_ready(fn(x))
+    t0 = time.perf_counter()
+    for _ in range(args.iters):
+        out = jax.block_until_ready(fn(out))
+    ar_ms = (time.perf_counter() - t0) / args.iters * 1e3
+
+    print(f"{n} devices, {args.params} f32/rank "
+          f"({args.params * 4 / 2**20:.1f} MiB model):")
+    print(f"{'topology':>22} {'rounds':>7} {'x model sent/step':>18} {'ms/step':>9}")
+    for name, rounds, deg, ms in rows:
+        print(f"{name:>22} {rounds:>7} {deg:>18.2f} {ms:>9.2f}")
+    print(f"{'global allreduce':>22} {'-':>7} {2 * (n - 1) / n:>18.2f} {ar_ms:>9.2f}")
+
+
+if __name__ == "__main__":
+    main()

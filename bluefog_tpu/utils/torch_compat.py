@@ -1,0 +1,57 @@
+"""Migration helpers for reference (PyTorch) users.
+
+The reference's user base holds model state as torch ``state_dict``s; these
+converters move weights across so a trained torch model can continue
+training decentralized here (or vice versa).  torch is an optional
+dependency — the module imports lazily.
+
+    params = torch_compat.from_torch(model.state_dict())     # flat dict of jnp
+    dist   = bf.optimizers.replicate(params)                  # onto the mesh
+    ...train...
+    model.load_state_dict(torch_compat.to_torch(params))
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Mapping
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["from_torch", "to_torch"]
+
+
+def from_torch(state_dict: Mapping[str, Any], *, dtype=None) -> Dict[str, Any]:
+    """torch ``state_dict`` -> nested pytree of jnp arrays.
+
+    Dotted names become nested dicts (``"layer1.0.weight"`` ->
+    ``tree["layer1"]["0"]["weight"]``); tensors convert via numpy (CPU).
+    """
+    tree: Dict[str, Any] = {}
+    for name, value in state_dict.items():
+        arr = value.detach().cpu().numpy() if hasattr(value, "detach") \
+            else np.asarray(value)
+        leaf = jnp.asarray(arr, dtype=dtype)
+        node = tree
+        parts = name.split(".")
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = leaf
+    return tree
+
+
+def to_torch(tree: Any) -> "Dict[str, Any]":
+    """Nested pytree -> flat torch ``state_dict`` (dotted names)."""
+    import torch
+
+    flat: Dict[str, Any] = {}
+
+    def walk(prefix, node):
+        if isinstance(node, Mapping):
+            for k, v in node.items():
+                walk(f"{prefix}.{k}" if prefix else str(k), v)
+        else:
+            flat[prefix] = torch.from_numpy(np.asarray(node).copy())
+
+    walk("", tree)
+    return flat

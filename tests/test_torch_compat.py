@@ -1,0 +1,28 @@
+"""torch state_dict <-> pytree round trip (migration path for reference users)."""
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+from bluefog_tpu.utils import torch_compat
+
+
+def test_roundtrip():
+    model = torch.nn.Sequential(
+        torch.nn.Linear(4, 8), torch.nn.ReLU(), torch.nn.Linear(8, 2))
+    sd = model.state_dict()
+    tree = torch_compat.from_torch(sd)
+    assert set(tree.keys()) == {"0", "2"}
+    assert tree["0"]["weight"].shape == (8, 4)
+    back = torch_compat.to_torch(tree)
+    assert set(back.keys()) == set(sd.keys())
+    for k in sd:
+        np.testing.assert_allclose(
+            back[k].numpy(), sd[k].detach().numpy(), rtol=1e-6)
+
+
+def test_dtype_override():
+    import jax.numpy as jnp
+    sd = {"w": torch.ones(3, 3, dtype=torch.float64)}
+    tree = torch_compat.from_torch(sd, dtype=jnp.bfloat16)
+    assert tree["w"].dtype == jnp.bfloat16

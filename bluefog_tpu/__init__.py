@@ -36,6 +36,7 @@ from .parallel import (
     in_neighbor_machine_ranks, out_neighbor_machine_ranks,
     static_schedule, machine_schedule, get_context,
     machine_rank, local_rank, suspend, resume,
+    set_dynamic_topology, clear_dynamic_topology, dynamic_schedules,
     win_create, win_free, win_put, win_accumulate, win_get,
     win_update, win_update_then_collect, win_mutex, get_win_version,
     win_associated_p,

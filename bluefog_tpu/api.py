@@ -150,13 +150,25 @@ def neighbor_allreduce(
     src_weights=None,
     dst_weights=None,
     schedule: Optional[CommSchedule] = None,
+    step: Optional[int] = None,
 ) -> jax.Array:
     """Weighted neighbor averaging of each rank's slice (the flagship op).
 
-    Reference: ``bf.neighbor_allreduce`` (``mpi_ops.py:540-592``).
+    Reference: ``bf.neighbor_allreduce`` (``mpi_ops.py:540-592``).  When a
+    dynamic topology is installed (``bf.set_dynamic_topology``), pass the
+    iteration counter as ``step`` and the matching schedule of the period is
+    used automatically.
     """
     ctx = _mesh.get_context()
     _check_distributed(x, ctx.size)
+    dyn = ctx.dynamic_schedules
+    if (dyn and schedule is None and self_weight is None
+            and src_weights is None and dst_weights is None):
+        if step is None:
+            raise ValueError(
+                "a dynamic topology is installed; pass step= (the iteration "
+                "counter) so the period's schedule can be selected")
+        schedule = dyn[int(step) % len(dyn)]
     sched = resolve_schedule(self_weight, src_weights, dst_weights, schedule)
     fn = _cached(
         ("nar", sched, ctx.mesh, x.shape, x.dtype.name),

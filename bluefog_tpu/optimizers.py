@@ -532,7 +532,12 @@ def _comm_from_type(communication_type: str, kw):
     scheds = kw.pop("schedules", None)
     if communication_type == "neighbor_allreduce":
         if sched is None and scheds is None:
-            sched = _mesh.static_schedule()
+            # an installed dynamic topology (bf.set_dynamic_topology) takes
+            # precedence over the static schedule — the reference's
+            # per-iteration weight-mutation pattern, compiled
+            scheds = _mesh.get_context().dynamic_schedules
+            if scheds is None:
+                sched = _mesh.static_schedule()
         comm = neighbor_communicator(sched, scheds)
     elif communication_type == "hierarchical_neighbor_allreduce":
         if sched is None and scheds is None:

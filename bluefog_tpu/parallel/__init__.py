@@ -26,6 +26,9 @@ from .context import (
     local_rank,
     suspend,
     resume,
+    set_dynamic_topology,
+    clear_dynamic_topology,
+    dynamic_schedules,
 )
 
 __all__ = [
@@ -38,6 +41,7 @@ __all__ = [
     "in_neighbor_machine_ranks", "out_neighbor_machine_ranks",
     "static_schedule", "machine_schedule", "get_context",
     "machine_rank", "local_rank", "suspend", "resume",
+    "set_dynamic_topology", "clear_dynamic_topology", "dynamic_schedules",
 ]
 
 from .windows import (

@@ -41,6 +41,7 @@ class BlueFogTpuContext:
     topology_weighted: bool = False
     machine_topology: Optional[nx.DiGraph] = None
     machine_topology_weighted: bool = False
+    dynamic_schedules: Optional[List[CommSchedule]] = None
     _sched: Optional[CommSchedule] = None
     _machine_sched: Optional[CommSchedule] = None
 
@@ -208,6 +209,7 @@ def set_topology(topology: Optional[nx.DiGraph] = None,
     ctx.topology = _check_topology(topology, ctx.size)
     ctx.topology_weighted = is_weighted
     ctx._sched = None
+    ctx.dynamic_schedules = None
     return True
 
 
@@ -271,6 +273,36 @@ def out_neighbor_machine_ranks(machine_rank: int) -> List[int]:
     if topo is None:
         raise RuntimeError("no machine topology set")
     return topo_util.GetOutNeighbors(topo, machine_rank)
+
+
+def set_dynamic_topology(generator_factory, num_steps: Optional[int] = None,
+                         uniform: bool = True) -> List[CommSchedule]:
+    """Install an iteration-varying topology from a one-peer generator family.
+
+    The reference's pattern is per-iteration mutation of the optimizer's
+    ``dst_weights/src_weights/self_weight`` from a generator
+    (``examples/pytorch_benchmark.py:182-208``); here the generator's period
+    compiles once into a schedule list stored on the context —
+    ``neighbor_allreduce(x, step=t)`` and the ``communication_type``
+    optimizer factories then pick it up automatically.
+
+    ``generator_factory(rank)`` returns the reference-style iterator yielding
+    ``([send_ranks], [recv_ranks])`` per iteration.  Returns the schedules.
+    """
+    from ..schedule import compile_dynamic_schedules
+    ctx = get_context()
+    scheds = compile_dynamic_schedules(
+        generator_factory, ctx.size, num_steps, uniform)
+    ctx.dynamic_schedules = scheds
+    return scheds
+
+
+def clear_dynamic_topology() -> None:
+    get_context().dynamic_schedules = None
+
+
+def dynamic_schedules() -> Optional[List[CommSchedule]]:
+    return get_context().dynamic_schedules
 
 
 def static_schedule() -> CommSchedule:

@@ -267,3 +267,30 @@ def test_ragged_neighbor_allgather():
         for k, s in enumerate(nbrs):
             valid = np.asarray(g[r, k * max_d0: k * max_d0 + lengths[s]])
             np.testing.assert_array_equal(valid, np.full(valid.shape, s))
+
+
+def test_context_dynamic_topology():
+    """bf.set_dynamic_topology installs period schedules used via step=."""
+    topo = tu.ExponentialTwoGraph(N)
+    bf.set_topology(topo)
+    scheds = bf.set_dynamic_topology(
+        lambda r: tu.GetDynamicOnePeerSendRecvRanks(topo, r))
+    assert bf.dynamic_schedules() is not None
+    with pytest.raises(ValueError):
+        bf.neighbor_allreduce(rank_tensor())     # step missing
+    gens = [tu.GetDynamicOnePeerSendRecvRanks(topo, r) for r in range(N)]
+    vals = np.arange(N, dtype=np.float64)
+    for t in range(4):
+        out = bf.neighbor_allreduce(rank_tensor(), step=t)
+        stepinfo = [next(g) for g in gens]
+        for r in range(N):
+            recvs = stepinfo[r][1]
+            expected = (vals[r] + sum(vals[s] for s in recvs)) / (len(recvs) + 1)
+            np.testing.assert_allclose(
+                np.asarray(out[r]), np.full(DIM, expected), rtol=1e-5)
+    # explicit schedule still works alongside
+    out = bf.neighbor_allreduce(rank_tensor(), schedule=scheds[0])
+    # set_topology clears the installed dynamic schedules
+    bf.set_topology(tu.RingGraph(N))
+    assert bf.dynamic_schedules() is None
+    bf.neighbor_allreduce(rank_tensor())         # static path again

@@ -68,10 +68,26 @@ class ShardedLoader:
         n = _mesh.size()
         return self.num_samples // n // self.batch_size
 
-    def __iter__(self) -> Iterator[Tuple[jax.Array, ...]]:
+    def epoch_arrays(self) -> Tuple[jax.Array, ...]:
+        """One epoch as stacked arrays ``[n, steps, batch, ...]`` per source.
+
+        The shape ``make_train_step(steps_per_call=steps)`` scans over — the
+        TPU-idiomatic one-dispatch-per-epoch loop.  Advances the epoch
+        counter (fresh shuffle per call), like one full ``__iter__`` pass.
+        """
         n = _mesh.size()
         ctx = _mesh.get_context()
         sharding = NamedSharding(ctx.mesh, P("rank"))
+        steps = self.steps_per_epoch()
+        batches = list(self._host_batches())
+        out = []
+        for i in range(len(self.arrays)):
+            stacked = np.stack([b[i] for b in batches], axis=1)  # [n, steps, B,...]
+            out.append(jax.device_put(stacked, sharding))
+        return tuple(out)
+
+    def _host_batches(self):
+        n = _mesh.size()
         steps = self.steps_per_epoch()
         if steps == 0:
             raise ValueError(
@@ -83,22 +99,21 @@ class ShardedLoader:
                 self.seed + self._epoch).permutation(order)
         self._epoch += 1
         per_rank = self.num_samples // n
+        for s in range(steps):
+            batch = []
+            for a in self.arrays:
+                idx = np.stack([
+                    order[r * per_rank + s * self.batch_size:
+                          r * per_rank + (s + 1) * self.batch_size]
+                    for r in range(n)
+                ])
+                batch.append(a[idx])
+            yield tuple(batch)
 
-        def host_batches():
-            for s in range(steps):
-                batch = []
-                for a in self.arrays:
-                    # rank r reads shard r: [n, B, ...]
-                    idx = np.stack([
-                        order[r * per_rank + s * self.batch_size:
-                              r * per_rank + (s + 1) * self.batch_size]
-                        for r in range(n)
-                    ])
-                    batch.append(a[idx])
-                yield tuple(batch)
-
+    def __iter__(self) -> Iterator[Tuple[jax.Array, ...]]:
+        sharding = NamedSharding(_mesh.get_context().mesh, P("rank"))
         yield from prefetch_to_device(
-            host_batches(), sharding, size=self.prefetch)
+            self._host_batches(), sharding, size=self.prefetch)
 
 
 def prefetch_to_device(

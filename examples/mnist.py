@@ -111,11 +111,10 @@ def main():
                            **({"schedules": scheds} if scheds else {}))
 
     # shard the dataset: rank r sees shard r (distinct data -> consensus test)
-    per_rank = len(x_all) // n
-    steps_per_epoch = per_rank // args.batch_size
-    x_sh = jnp.asarray(x_all[: n * per_rank]).reshape(
-        n, per_rank, 28, 28, 1)
-    y_sh = jnp.asarray(y_all[: n * per_rank]).reshape(n, per_rank)
+    from bluefog_tpu.data import ShardedLoader
+    loader = ShardedLoader([x_all, y_all], args.batch_size, shuffle=True,
+                           seed=args.seed)
+    steps_per_epoch = loader.steps_per_epoch()
 
     dist_params = bfopt.replicate(params)
     dist_state = bfopt.init_distributed(strategy, dist_params)
@@ -123,11 +122,8 @@ def main():
                                  steps_per_call=steps_per_epoch)
 
     for epoch in range(args.epochs):
-        # one compiled call per epoch: scan over batches
-        xb = x_sh[:, : steps_per_epoch * args.batch_size].reshape(
-            n, steps_per_epoch, args.batch_size, 28, 28, 1)
-        yb = y_sh[:, : steps_per_epoch * args.batch_size].reshape(
-            n, steps_per_epoch, args.batch_size)
+        # one compiled call per epoch: scan over the loader's stacked batches
+        xb, yb = loader.epoch_arrays()
         dist_params, dist_state, losses = step(dist_params, dist_state, (xb, yb))
         losses = np.asarray(jax.block_until_ready(losses))
         print(f"epoch {epoch}: mean loss {losses.mean():.4f} "

@@ -60,3 +60,14 @@ def test_watchdog_passthrough():
     x = jnp.arange(8.0)
     y = synchronize_with_watchdog(x * 2, interval=60.0, name="test")
     np.testing.assert_allclose(np.asarray(y), np.arange(8.0) * 2)
+
+
+def test_epoch_arrays_shape_and_coverage():
+    x = np.arange(N * 16, dtype=np.float32)
+    y = x * 2
+    loader = ShardedLoader([x, y], batch_size=4, shuffle=False)
+    xb, yb = loader.epoch_arrays()
+    steps = loader.steps_per_epoch()
+    assert xb.shape == (N, steps, 4) and yb.shape == (N, steps, 4)
+    np.testing.assert_allclose(np.asarray(yb), np.asarray(xb) * 2)
+    assert sorted(np.asarray(xb).ravel().tolist()) == x.tolist()

@@ -83,12 +83,15 @@ def main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
     if args.interactive:
         env = _child_env(args)
+        # honor JAX_PLATFORMS even under plugins that force jax_platforms at
+        # boot (bf.init(platform=...) pins the config)
+        bootstrap = (
+            "import os, bluefog_tpu as bf; "
+            "bf.init(platform=os.environ.get('JAX_PLATFORMS') or None); "
+            "print(f'bluefog_tpu ready: {bf.size()} rank(s), "
+            "topology={bf.load_topology().__class__.__name__}')")
         return subprocess.call(
-            [sys.executable, "-i", "-c",
-             "import bluefog_tpu as bf; bf.init(); "
-             "print(f'bluefog_tpu ready: {bf.size()} rank(s), "
-             "topology={bf.load_topology().__class__.__name__}')"],
-            env=env)
+            [sys.executable, "-i", "-c", bootstrap], env=env)
     if not args.command:
         build_parser().print_help()
         return 2

@@ -112,6 +112,7 @@ def init(
             from ..run.launcher import maybe_initialize_distributed
             maybe_initialize_distributed()
             devices = jax.devices()
+        devices = _torus_order(devices)
     devs = np.asarray(devices, dtype=object)
     n = len(devs)
     if nodes_per_machine is None:
@@ -137,6 +138,21 @@ def init(
     with _lock:
         _context = ctx
     return ctx
+
+
+def _torus_order(devices):
+    """Order the rank axis along the physical ICI torus so ring/neighbor
+    ppermutes ride single-hop links (a raw ``jax.devices()`` enumeration can
+    zig-zag across the torus).  Applied only to auto-discovered devices —
+    explicit lists are the caller's ordering."""
+    if len(devices) <= 1:
+        return devices
+    try:
+        from jax.experimental import mesh_utils
+        return list(
+            mesh_utils.create_device_mesh((len(devices),), devices=devices).flat)
+    except Exception:
+        return devices    # non-torus backends: keep enumeration order
 
 
 def _check_topology(topo: nx.DiGraph, size: int) -> nx.DiGraph:

@@ -112,7 +112,12 @@ def init(
             from ..run.launcher import maybe_initialize_distributed
             maybe_initialize_distributed()
             devices = jax.devices()
-        devices = _torus_order(devices)
+        if jax.process_count() == 1:
+            # multi-process keeps jax's process-grouped order: the 2-D
+            # (machine, local) mesh and machine_rank/local_rank require each
+            # host's chip block to stay contiguous, which a 1-D torus snake
+            # does not guarantee across hosts
+            devices = _torus_order(devices)
     devs = np.asarray(devices, dtype=object)
     n = len(devs)
     if nodes_per_machine is None:

@@ -33,16 +33,19 @@ NEG_INF = -1e30  # large-negative stand-in: keeps exp() exact zeros without nan
 
 
 def _partial_kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref,
-                    o_ref, l_ref, m_ref, *, causal: bool, scale: float):
-    q = q_ref[0].astype(jnp.float32) * scale          # [Tq, D]
+                    o_ref, l_ref, m_ref, *, causal: bool, scale: float,
+                    block_q: int):
+    q = q_ref[0].astype(jnp.float32) * scale          # [QB, D]
     k = k_ref[0].astype(jnp.float32)                  # [Tk, D]
     v = v_ref[0].astype(jnp.float32)                  # [Tk, D]
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32)           # [Tq, Tk]
+        preferred_element_type=jnp.float32)           # [QB, Tk]
     if causal:
         tq, tk = s.shape
-        q_pos = qoff_ref[0] + jax.lax.broadcasted_iota(jnp.int32, (tq, tk), 0)
+        # this grid step covers q rows [j*QB, (j+1)*QB) of the device block
+        base = qoff_ref[0] + pl.program_id(1) * block_q
+        q_pos = base + jax.lax.broadcasted_iota(jnp.int32, (tq, tk), 0)
         k_pos = koff_ref[0] + jax.lax.broadcasted_iota(jnp.int32, (tq, tk), 1)
         s = jnp.where(q_pos >= k_pos, s, NEG_INF)
     m = jnp.max(s, axis=-1, keepdims=True)            # [Tq, 1]
@@ -59,7 +62,7 @@ def _partial_kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref,
 
 
 @functools.partial(
-    jax.jit, static_argnames=("causal", "scale", "interpret"))
+    jax.jit, static_argnames=("causal", "scale", "interpret", "block_q"))
 def attention_block_partial(
     q: jax.Array,                  # [B, Tq, H, D]
     k: jax.Array,                  # [B, Tk, H, D]
@@ -70,6 +73,7 @@ def attention_block_partial(
     causal: bool = False,
     scale: float = 1.0,
     interpret: Optional[bool] = None,
+    block_q: int = 512,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """One K/V block's flash-attention partial, fully in VMEM.
 
@@ -87,25 +91,30 @@ def attention_block_partial(
     kr = k.transpose(0, 2, 1, 3).reshape(B * H, Tk, D)
     vr = v.transpose(0, 2, 1, 3).reshape(B * H, Tk, D)
 
-    kernel = functools.partial(_partial_kernel, causal=causal, scale=scale)
+    # q-blocking bounds VMEM: the score tile is [QB, Tk] instead of
+    # [Tq, Tk] (a 4k-token local block would otherwise need a 64 MB tile)
+    qb = Tq if Tq % block_q else min(block_q, Tq)
+    kernel = functools.partial(_partial_kernel, causal=causal, scale=scale,
+                               block_q=qb)
     # under shard_map the outputs vary over the same mesh axes as the inputs
     vma = getattr(jax.typeof(qr), "vma", frozenset()) or frozenset()
-    grid = (B * H,)
-    data_spec = lambda t, d: pl.BlockSpec((1, t, d), lambda i: (i, 0, 0))
+    grid = (B * H, Tq // qb)
+    q_spec = lambda t, d: pl.BlockSpec((1, t, d), lambda i, j: (i, j, 0))
+    kv_spec = lambda t, d: pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0))
     o, l, m = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),   # scalar offsets
             pl.BlockSpec(memory_space=pltpu.SMEM),
-            data_spec(Tq, D),
-            data_spec(Tk, D),
-            data_spec(Tk, D),
+            q_spec(qb, D),
+            kv_spec(Tk, D),
+            kv_spec(Tk, D),
         ],
         out_specs=[
-            data_spec(Tq, D),
-            data_spec(Tq, 1),
-            data_spec(Tq, 1),
+            q_spec(qb, D),
+            q_spec(qb, 1),
+            q_spec(qb, 1),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B * H, Tq, D), jnp.float32, vma=vma),

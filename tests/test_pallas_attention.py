@@ -157,3 +157,20 @@ def test_pallas_path_is_trainable(cpu_devices):
                 np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5)
     finally:
         bf.shutdown()
+
+
+def test_q_blocking_matches_unblocked():
+    """block_q < Tq tiles the grid; result identical to one big block."""
+    rng = np.random.default_rng(5)
+    B, T, H, D = 1, 32, 2, 8
+    q, k, v = (jnp.asarray(rng.normal(size=(B, T, H, D)), jnp.float32)
+               for _ in range(3))
+    full = pa.attention_block_partial(
+        q, k, v, jnp.asarray(16), jnp.asarray(0), causal=True,
+        scale=0.3, interpret=True, block_q=T)
+    tiled = pa.attention_block_partial(
+        q, k, v, jnp.asarray(16), jnp.asarray(0), causal=True,
+        scale=0.3, interpret=True, block_q=8)
+    for a, b in zip(full, tiled):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-6)

@@ -65,6 +65,7 @@ def ring_attention(
     causal: bool = False,
     scale: Optional[float] = None,
     use_pallas: bool = False,
+    pallas_block_q: int = 512,
 ) -> jax.Array:
     """Exact attention over a sequence sharded along ``axis``.
 
@@ -88,11 +89,13 @@ def ring_attention(
     blk_q, blk_k = q.shape[1], k.shape[1]
 
     if use_pallas:
-        return _pallas_ring_attention(q, k, v, axis, causal, float(scale))
+        return _pallas_ring_attention(
+            q, k, v, axis, causal, float(scale), pallas_block_q)
     return _jnp_ring_attention(q, k, v, axis, causal, float(scale))
 
 
-def _pallas_forward(q, k, v, axis: Axis, causal: bool, scale: float):
+def _pallas_forward(q, k, v, axis: Axis, causal: bool, scale: float,
+                    block_q: int = 512):
     from . import pallas_attention as pa
     n = lax.axis_size(axis)
     idx = lax.axis_index(axis)
@@ -108,7 +111,7 @@ def _pallas_forward(q, k, v, axis: Axis, causal: bool, scale: float):
         src = (idx - t) % n
         part = pa.attention_block_partial(
             q, kt, vt, idx * blk_q, src * blk_k,
-            causal=causal, scale=scale)
+            causal=causal, scale=scale, block_q=block_q)
         o, l, m = pa.merge_partials((o, l, m), part)
         kt = lax.ppermute(kt, axis, perm=perm_p)
         vt = lax.ppermute(vt, axis, perm=perm_p)
@@ -119,8 +122,9 @@ def _pallas_forward(q, k, v, axis: Axis, causal: bool, scale: float):
     return (o / l[..., None]).astype(q.dtype)
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _pallas_ring_attention(q, k, v, axis: Axis, causal: bool, scale: float):
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _pallas_ring_attention(q, k, v, axis: Axis, causal: bool, scale: float,
+                           block_q: int = 512):
     """Pallas forward with a recompute backward.
 
     The kernel has no VJP rule, so the backward differentiates the pure-jnp
@@ -128,14 +132,14 @@ def _pallas_ring_attention(q, k, v, axis: Axis, causal: bool, scale: float):
     score matrix in VMEM; backward recomputes blockwise in jnp — standard
     flash-attention recompute, paid only when training.
     """
-    return _pallas_forward(q, k, v, axis, causal, scale)
+    return _pallas_forward(q, k, v, axis, causal, scale, block_q)
 
 
-def _pallas_ring_fwd(q, k, v, axis, causal, scale):
-    return _pallas_forward(q, k, v, axis, causal, scale), (q, k, v)
+def _pallas_ring_fwd(q, k, v, axis, causal, scale, block_q=512):
+    return _pallas_forward(q, k, v, axis, causal, scale, block_q), (q, k, v)
 
 
-def _pallas_ring_bwd(axis, causal, scale, res, g):
+def _pallas_ring_bwd(axis, causal, scale, block_q, res, g):
     q, k, v = res
     _, vjp = jax.vjp(
         lambda q_, k_, v_: _jnp_ring_attention(q_, k_, v_, axis, causal, scale),

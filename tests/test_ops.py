@@ -294,3 +294,23 @@ def test_context_dynamic_topology():
     bf.set_topology(tu.RingGraph(N))
     assert bf.dynamic_schedules() is None
     bf.neighbor_allreduce(rank_tensor())         # static path again
+
+
+def test_dynamic_empty_send_recv():
+    """A rank with no edges in a dynamic step keeps its value scaled by its
+    self weight (reference: empty-send dynamic cases, torch_ops_test 430-605)."""
+    bf.set_topology(tu.ExponentialTwoGraph(N))
+    # rank 0 isolated this step; others form a shifted ring skipping 0
+    ring = [(r, r % (N - 1) + 1) for r in range(1, N)]
+    src_weights = [dict() for _ in range(N)]
+    for s, d in ring:
+        src_weights[d][s] = 0.5
+    self_weights = [1.0] + [0.5] * (N - 1)
+    out = bf.neighbor_allreduce(
+        rank_tensor(), self_weight=self_weights, src_weights=src_weights)
+    vals = np.arange(N, dtype=np.float64)
+    np.testing.assert_allclose(np.asarray(out[0]), np.full(DIM, 0.0), atol=1e-6)
+    for s, d in ring:
+        np.testing.assert_allclose(
+            np.asarray(out[d]), np.full(DIM, 0.5 * vals[d] + 0.5 * vals[s]),
+            rtol=1e-5)

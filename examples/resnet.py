@@ -86,9 +86,10 @@ def main():
                            train=False)
     state0 = {"params": variables["params"], "bs": variables["batch_stats"]}
 
+    from bluefog_tpu.data import ShardedLoader
     B = args.batch_size
-    per_rank = len(x_tr) // n
-    steps_per_epoch = max(per_rank // B, 1)
+    loader = ShardedLoader([x_tr, y_tr], B, shuffle=True, seed=args.seed)
+    steps_per_epoch = loader.steps_per_epoch()
     total_steps = steps_per_epoch * args.epochs
 
     # LR warmup then staircase decay at 50%/75% (reference :167-186 pattern)
@@ -133,10 +134,6 @@ def main():
             opt, communication_type=name,
             **({"schedules": scheds} if scheds else {}))
 
-    x_sh = jnp.asarray(x_tr[: n * per_rank]).reshape(
-        (n, per_rank) + x_tr.shape[1:])
-    y_sh = jnp.asarray(y_tr[: n * per_rank]).reshape(n, per_rank)
-
     dist_params = bfopt.replicate(state0)
     dist_state = bfopt.init_distributed(strategy, dist_params)
     start_epoch = 0
@@ -167,9 +164,7 @@ def main():
         return (jnp.argmax(logits, -1) == jnp.asarray(y_te)).mean()
 
     for epoch in range(start_epoch, args.epochs):
-        xb = x_sh[:, : steps_per_epoch * B].reshape(
-            (n, steps_per_epoch, B) + x_tr.shape[1:])
-        yb = y_sh[:, : steps_per_epoch * B].reshape(n, steps_per_epoch, B)
+        xb, yb = loader.epoch_arrays()
         dist_params, dist_state, losses = step(
             dist_params, dist_state, (xb, yb))
         losses = np.asarray(jax.block_until_ready(losses))

@@ -1,0 +1,59 @@
+"""Launcher env construction: flag gating, -x parsing, pod detection."""
+import pytest
+
+from bluefog_tpu.run import launcher
+from bluefog_tpu.utils.config import looks_like_tpu_environment
+
+
+def _env(argv, base=None, monkeypatch=None):
+    args = launcher.build_parser().parse_args(argv + ["python", "x.py"])
+    return launcher._child_env(args)
+
+
+def test_x_env_parsing(monkeypatch):
+    monkeypatch.delenv("XLA_FLAGS", raising=False)
+    env = _env(["-x", "FOO=bar", "-x", "BAZ=1"])
+    assert env["FOO"] == "bar" and env["BAZ"] == "1"
+    with pytest.raises(SystemExit):
+        _env(["-x", "MALFORMED"])
+
+
+def test_timeline_flag(monkeypatch):
+    env = _env(["--timeline-filename", "/tmp/tl"])
+    assert env["BLUEFOG_TIMELINE"] == "/tmp/tl"
+
+
+def test_xla_tuning_gated_on_tpu_env(monkeypatch):
+    # axon-style tunnel plugin: TPU_* vars present but flags must NOT be set
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "10.0.0.1")
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "localhost")
+    monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v5e-1")
+    monkeypatch.delenv("XLA_FLAGS", raising=False)
+    env = _env([])
+    assert "xla_tpu_enable_async_collective_fusion" not in env.get("XLA_FLAGS", "")
+
+    # real multi-host pod: flags injected
+    monkeypatch.delenv("PALLAS_AXON_POOL_IPS")
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "host0,host1")
+    env = _env([])
+    assert "xla_tpu_enable_async_collective_fusion" in env["XLA_FLAGS"]
+
+    # opt-out respected
+    env = _env(["--no-xla-tuning"])
+    assert "xla_tpu_enable_async_collective_fusion" not in env.get("XLA_FLAGS", "")
+
+
+def test_looks_like_tpu_environment():
+    assert not looks_like_tpu_environment({})
+    assert not looks_like_tpu_environment({"TPU_WORKER_HOSTNAMES": "localhost"})
+    assert not looks_like_tpu_environment(
+        {"PALLAS_AXON_POOL_IPS": "1.2.3.4", "TPU_WORKER_HOSTNAMES": "a,b"})
+    assert looks_like_tpu_environment({"TPU_WORKER_HOSTNAMES": "a,b"})
+    assert looks_like_tpu_environment({"JAX_PLATFORMS": "tpu,cpu"})
+    assert looks_like_tpu_environment({"MEGASCALE_COORDINATOR_ADDRESS": "x:1"})
+
+
+def test_coordinator_requires_process_id():
+    with pytest.raises(SystemExit):
+        launcher.main(["--coordinator", "h:1", "--num-processes", "2",
+                       "true"])

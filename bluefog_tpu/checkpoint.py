@@ -35,16 +35,20 @@ def _checkpointer():
 def save(directory: str, state: Any, step: int, *, keep: Optional[int] = None) -> str:
     """Write ``state`` (any pytree of arrays) as ``<directory>/step_<step>``.
 
-    ``keep`` prunes to the newest N step directories (None = keep all).
-    Returns the checkpoint path.
+    ``keep`` prunes to the newest N step directories (None = keep all; must
+    be >= 1 otherwise).  Returns the checkpoint path.
     """
+    if keep is not None and keep < 1:
+        raise ValueError(f"keep must be >= 1 (got {keep}); use keep=None to keep all")
     directory = os.path.abspath(directory)
     os.makedirs(directory, exist_ok=True)
     path = os.path.join(directory, f"step_{int(step)}")
     # block so the snapshot is consistent even mid-training-loop
     state = jax.block_until_ready(state)
     _checkpointer().save(path, state, force=True)
-    if keep is not None:
+    # Prune from one process only: in multi-process runs the directory is
+    # shared, and concurrent rmtree races against other processes' saves.
+    if keep is not None and jax.process_index() == 0:
         steps = sorted(all_steps(directory))
         for s in steps[:-keep]:
             _rmtree(os.path.join(directory, f"step_{s}"))

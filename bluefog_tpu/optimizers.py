@@ -347,7 +347,15 @@ def push_sum(
     (directed, unbalanced) where plain gossip would drift.
     """
     def _sched():
-        return sched if sched is not None else _mesh.static_schedule()
+        s = sched if sched is not None else _mesh.static_schedule()
+        if s.uses_dst_weighting:
+            # push_sum scales outgoing mass itself (x * dw below); a schedule
+            # with baked-in send scales would make win_accumulate scale again,
+            # double-weighting sends and breaking mass conservation.
+            raise ValueError(
+                "push_sum requires a schedule without dst-weighting "
+                "(uses_dst_weighting=False); pass dst_weight= instead")
+        return s
 
     def _vals(params):
         return fusion.fuse_tree(params).buffers if fuse else params

@@ -312,16 +312,26 @@ def GetDynamicOnePeerSendRecvRanks(
     """
     size = topo.number_of_nodes()
     sends = _clockwise_out_neighbors(topo)
-    index = 0
-    while True:
-        send_rank = sends[self_rank][index % len(sends[self_rank])]
-        recv_ranks = [
-            other for other in range(size)
-            if other != self_rank
-            and sends[other][index % len(sends[other])] == self_rank
-        ]
-        yield [send_rank], recv_ranks
-        index += 1
+    for rank, nbrs in enumerate(sends):
+        if not nbrs:
+            raise ValueError(
+                f"rank {rank} has no out-neighbors besides itself in the base "
+                "topology; every rank needs out-degree >= 1 (excluding self) "
+                "for a one-peer dynamic schedule")
+
+    def _gen():
+        index = 0
+        while True:
+            send_rank = sends[self_rank][index % len(sends[self_rank])]
+            recv_ranks = [
+                other for other in range(size)
+                if other != self_rank
+                and sends[other][index % len(sends[other])] == self_rank
+            ]
+            yield [send_rank], recv_ranks
+            index += 1
+
+    return _gen()
 
 
 def GetExp2DynamicSendRecvMachineRanks(

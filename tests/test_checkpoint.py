@@ -84,3 +84,9 @@ def test_keep_prunes_old(tmp_path):
 def test_restore_latest_empty(tmp_path):
     out, step = ckpt.restore_latest(str(tmp_path))
     assert out is None and step is None
+
+
+def test_keep_zero_rejected(tmp_path):
+    tree = {"x": jnp.zeros((N, 2))}
+    with pytest.raises(ValueError, match="keep"):
+        ckpt.save(str(tmp_path), tree, step=1, keep=0)

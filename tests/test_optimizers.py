@@ -139,6 +139,20 @@ def test_push_sum_optimizer():
     _check(w, w_opt)
 
 
+def test_push_sum_rejects_dst_weighted_schedule():
+    """A schedule with baked-in send scales would double-scale outgoing mass
+    on top of push_sum's own dw multiplier, breaking mass conservation."""
+    from bluefog_tpu import schedule as sched_mod
+    topo = tu.RingGraph(N, connect_style=2)
+    srcs = [{s: 0.5 for s in tu.GetInNeighbors(topo, r)} for r in range(N)]
+    dsts = [{d: 0.25 for d in tu.GetOutNeighbors(topo, r)} for r in range(N)]
+    dst = sched_mod.compile_from_weights(N, [0.5] * N, srcs, dsts)
+    assert dst.uses_dst_weighting
+    strat = bfopt.push_sum(optax.sgd(0.03), dst)
+    with pytest.raises(ValueError, match="dst-weighting"):
+        strat.init({"x": jnp.zeros((N, 1, 4))})
+
+
 def test_adam_composes():
     strat = bfopt.DistributedAdaptThenCombineOptimizer(
         optax.adam(0.05), communication_type="neighbor_allreduce")

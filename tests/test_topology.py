@@ -102,6 +102,17 @@ def test_dynamic_one_peer_matches_recv():
                 assert sends[src] == [r]
 
 
+def test_dynamic_one_peer_rejects_isolated_rank():
+    """A rank with no non-self out-neighbors fails at construction, clearly."""
+    topo = nx.DiGraph()
+    topo.add_nodes_from(range(4))
+    topo.add_edges_from([(0, 1), (1, 2), (2, 0)])  # rank 3 isolated
+    for r in range(4):
+        topo.add_edge(r, r)
+    with pytest.raises(ValueError, match="out-neighbors"):
+        tu.GetDynamicOnePeerSendRecvRanks(topo, 0)
+
+
 def test_inner_outer_expo2_consistency():
     world, local = 16, 4
     gens = [tu.GetInnerOuterExpo2DynamicSendRecvRanks(world, local, r)

@@ -32,6 +32,64 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30  # large-negative stand-in: keeps exp() exact zeros without nan
 
 
+# --- scaffolding shared by the forward and backward pallas_calls -----------
+
+def _split_heads(x: jax.Array) -> jax.Array:
+    """[B, T, H, D] -> [B*H, T, D]: one grid step per (batch, head)."""
+    B, T, H, D = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(B * H, T, D)
+
+
+def _merge_heads(x: jax.Array, B: int, H: int) -> jax.Array:
+    """[B*H, T, D] -> [B, T, H, D]."""
+    _, T, D = x.shape
+    return x.reshape(B, H, T, D).transpose(0, 2, 1, 3)
+
+
+def _q_blocking(Tq: int, block_q: int):
+    """q-blocking bounds VMEM: the score tile is [QB, Tk] instead of
+    [Tq, Tk] (a 4k-token local block would otherwise need a 64 MB tile).
+    Non-divisible Tq is padded up to a block multiple — never fall back to
+    one full [Tq, Tk] tile, which is the exact blow-up blocking prevents.
+    Returns ``(qb, pad, Tp)`` with ``Tp = Tq + pad`` a multiple of ``qb``."""
+    qb = min(block_q, Tq)
+    pad = (-Tq) % qb
+    return qb, pad, Tq + pad
+
+
+def _pad_rows(x: jax.Array, pad: int, value: float = 0.0) -> jax.Array:
+    if not pad:
+        return x
+    return jnp.pad(x, ((0, 0), (0, pad), (0, 0)), constant_values=value)
+
+
+def _q_spec(t: int, d: int) -> pl.BlockSpec:
+    return pl.BlockSpec((1, t, d), lambda i, j: (i, j, 0))
+
+
+def _kv_spec(t: int, d: int) -> pl.BlockSpec:
+    return pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0))
+
+
+def _smem_scalar(x: jax.Array) -> jax.Array:
+    return jnp.reshape(x.astype(jnp.int32), (1,))
+
+
+def _vma_of(x: jax.Array):
+    # under shard_map the outputs vary over the same mesh axes as the inputs
+    return getattr(jax.typeof(x), "vma", frozenset()) or frozenset()
+
+
+def _apply_causal_mask(s, qoff_ref, koff_ref, block_q: int):
+    """In-kernel: mask scores above the diagonal given the global offsets of
+    this grid step's q rows (``qoff + j*block_q``) and the K block."""
+    tq, tk = s.shape
+    base = qoff_ref[0] + pl.program_id(1) * block_q
+    q_pos = base + jax.lax.broadcasted_iota(jnp.int32, (tq, tk), 0)
+    k_pos = koff_ref[0] + jax.lax.broadcasted_iota(jnp.int32, (tq, tk), 1)
+    return jnp.where(q_pos >= k_pos, s, NEG_INF)
+
+
 def _partial_kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref,
                     o_ref, l_ref, m_ref, *, causal: bool, scale: float,
                     block_q: int):
@@ -42,12 +100,7 @@ def _partial_kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref,
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32)           # [QB, Tk]
     if causal:
-        tq, tk = s.shape
-        # this grid step covers q rows [j*QB, (j+1)*QB) of the device block
-        base = qoff_ref[0] + pl.program_id(1) * block_q
-        q_pos = base + jax.lax.broadcasted_iota(jnp.int32, (tq, tk), 0)
-        k_pos = koff_ref[0] + jax.lax.broadcasted_iota(jnp.int32, (tq, tk), 1)
-        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        s = _apply_causal_mask(s, qoff_ref, koff_ref, block_q)
     m = jnp.max(s, axis=-1, keepdims=True)            # [Tq, 1]
     safe_m = jnp.where(m <= NEG_INF / 2, 0.0, m)
     p = jnp.exp(s - safe_m)
@@ -86,50 +139,174 @@ def attention_block_partial(
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
 
-    # [B, Tq, H, D] -> [B*H, Tq, D]: one grid step per (batch, head)
-    qr = q.transpose(0, 2, 1, 3).reshape(B * H, Tq, D)
-    kr = k.transpose(0, 2, 1, 3).reshape(B * H, Tk, D)
-    vr = v.transpose(0, 2, 1, 3).reshape(B * H, Tk, D)
+    qb, pad, Tp = _q_blocking(Tq, block_q)
+    qr = _pad_rows(_split_heads(q), pad)
+    kr, vr = _split_heads(k), _split_heads(v)
 
-    # q-blocking bounds VMEM: the score tile is [QB, Tk] instead of
-    # [Tq, Tk] (a 4k-token local block would otherwise need a 64 MB tile)
-    qb = Tq if Tq % block_q else min(block_q, Tq)
     kernel = functools.partial(_partial_kernel, causal=causal, scale=scale,
                                block_q=qb)
-    # under shard_map the outputs vary over the same mesh axes as the inputs
-    vma = getattr(jax.typeof(qr), "vma", frozenset()) or frozenset()
-    grid = (B * H, Tq // qb)
-    q_spec = lambda t, d: pl.BlockSpec((1, t, d), lambda i, j: (i, j, 0))
-    kv_spec = lambda t, d: pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0))
+    vma = _vma_of(qr)
     o, l, m = pl.pallas_call(
         kernel,
-        grid=grid,
+        grid=(B * H, Tp // qb),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),   # scalar offsets
             pl.BlockSpec(memory_space=pltpu.SMEM),
-            q_spec(qb, D),
-            kv_spec(Tk, D),
-            kv_spec(Tk, D),
+            _q_spec(qb, D),
+            _kv_spec(Tk, D),
+            _kv_spec(Tk, D),
         ],
         out_specs=[
-            q_spec(qb, D),
-            q_spec(qb, 1),
-            q_spec(qb, 1),
+            _q_spec(qb, D),
+            _q_spec(qb, 1),
+            _q_spec(qb, 1),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((B * H, Tq, D), jnp.float32, vma=vma),
-            jax.ShapeDtypeStruct((B * H, Tq, 1), jnp.float32, vma=vma),
-            jax.ShapeDtypeStruct((B * H, Tq, 1), jnp.float32, vma=vma),
+            jax.ShapeDtypeStruct((B * H, Tp, D), jnp.float32, vma=vma),
+            jax.ShapeDtypeStruct((B * H, Tp, 1), jnp.float32, vma=vma),
+            jax.ShapeDtypeStruct((B * H, Tp, 1), jnp.float32, vma=vma),
         ],
         interpret=interpret,
-    )(jnp.reshape(q_offset.astype(jnp.int32), (1,)),
-      jnp.reshape(k_offset.astype(jnp.int32), (1,)),
-      qr, kr, vr)
+    )(_smem_scalar(q_offset), _smem_scalar(k_offset), qr, kr, vr)
 
-    o = o.reshape(B, H, Tq, D).transpose(0, 2, 1, 3)
-    l = l.reshape(B, H, Tq).transpose(0, 2, 1)
-    m = m.reshape(B, H, Tq).transpose(0, 2, 1)
+    o = _merge_heads(o[:, :Tq], B, H)
+    l = _merge_heads(l[:, :Tq], B, H)[..., 0]
+    m = _merge_heads(m[:, :Tq], B, H)[..., 0]
     return o, l, m
+
+
+def _backward_kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref, do_ref,
+                     lse_ref, delta_ref, dq_ref, dk_ref, dv_ref, *,
+                     causal: bool, scale: float, block_q: int):
+    """Flash-attention backward for one K/V block, scores recomputed in VMEM.
+
+    Standard FlashAttention-2 backward recurrence with the *global* softmax
+    statistics (lse over the full ring) supplied per q row:
+
+        p  = exp(s - lse)          # normalized probabilities, s = scale q k^T
+        dv = p^T do
+        dp = do v^T
+        ds = p * (dp - delta)      # delta_i = do_i . o_i
+        dq += scale ds k           # accumulated over K/V blocks by the caller
+        dk  = scale ds^T q         # accumulated over q blocks by this grid
+        dv, dk accumulate across the q-block grid dimension (sequential on TPU)
+    """
+    j = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)                  # [QB, D]
+    k = k_ref[0].astype(jnp.float32)                  # [Tk, D]
+    v = v_ref[0].astype(jnp.float32)                  # [Tk, D]
+    do = do_ref[0].astype(jnp.float32)                # [QB, D]
+    lse = lse_ref[0]                                  # [QB, 1] (-inf: no keys)
+    delta = delta_ref[0]                              # [QB, 1]
+
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale   # [QB, Tk]
+    if causal:
+        s = _apply_causal_mask(s, qoff_ref, koff_ref, block_q)
+    safe_lse = jnp.where(jnp.isneginf(lse), 0.0, lse)
+    p = jnp.exp(s - safe_lse)
+    # masked scores and rows with no valid keys (padded rows carry lse=-inf)
+    p = jnp.where((s <= NEG_INF / 2) | jnp.isneginf(lse), 0.0, p)
+
+    dv = jax.lax.dot_general(
+        p, do, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)           # [Tk, D]
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)           # [QB, Tk]
+    ds = p * (dp - delta)                             # [QB, Tk]
+    dq = jax.lax.dot_general(
+        ds, k, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale   # [QB, D]
+    dk = jax.lax.dot_general(
+        ds, q, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale   # [Tk, D]
+
+    dq_ref[0] = dq
+
+    @pl.when(j == 0)
+    def _():
+        dk_ref[0] = dk
+        dv_ref[0] = dv
+
+    @pl.when(j != 0)
+    def _():
+        dk_ref[0] += dk
+        dv_ref[0] += dv
+
+
+@functools.partial(
+    jax.jit, static_argnames=("causal", "scale", "interpret", "block_q"))
+def attention_block_backward(
+    q: jax.Array,                  # [B, Tq, H, D]
+    k: jax.Array,                  # [B, Tk, H, D]
+    v: jax.Array,                  # [B, Tk, H, D]
+    do: jax.Array,                 # [B, Tq, H, D] — cotangent of the output
+    lse: jax.Array,                # [B, Tq, H] f32 — global log-sum-exp
+    delta: jax.Array,              # [B, Tq, H] f32 — rowsum(do * o)
+    q_offset: jax.Array,           # [] int32
+    k_offset: jax.Array,           # [] int32
+    *,
+    causal: bool = False,
+    scale: float = 1.0,
+    interpret: Optional[bool] = None,
+    block_q: int = 512,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One K/V block's backward partial: ``(dq, dk_blk, dv_blk)``, all f32.
+
+    ``dq`` is this block's *contribution* to the query gradient (sum over
+    blocks in the ring caller); ``dk_blk/dv_blk`` are complete for this block
+    w.r.t. this device's queries (sum over devices as the block rotates).
+    """
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    qb, pad, Tp = _q_blocking(Tq, block_q)
+    qr = _pad_rows(_split_heads(q), pad)
+    kr, vr = _split_heads(k), _split_heads(v)
+    dor = _pad_rows(_split_heads(do), pad)
+    # -inf lse rows give p = 0: padded rows contribute nothing to dk/dv
+    lser = _pad_rows(_split_heads(lse.astype(jnp.float32)[..., None]),
+                     pad, value=-jnp.inf)
+    deltar = _pad_rows(_split_heads(delta.astype(jnp.float32)[..., None]), pad)
+
+    kernel = functools.partial(_backward_kernel, causal=causal, scale=scale,
+                               block_q=qb)
+    vma = _vma_of(qr)
+    dq, dk, dv = pl.pallas_call(
+        kernel,
+        grid=(B * H, Tp // qb),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            _q_spec(qb, D),
+            _kv_spec(Tk, D),
+            _kv_spec(Tk, D),
+            _q_spec(qb, D),
+            _q_spec(qb, 1),
+            _q_spec(qb, 1),
+        ],
+        out_specs=[
+            _q_spec(qb, D),
+            _kv_spec(Tk, D),
+            _kv_spec(Tk, D),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, Tp, D), jnp.float32, vma=vma),
+            jax.ShapeDtypeStruct((B * H, Tk, D), jnp.float32, vma=vma),
+            jax.ShapeDtypeStruct((B * H, Tk, D), jnp.float32, vma=vma),
+        ],
+        interpret=interpret,
+    )(_smem_scalar(q_offset), _smem_scalar(k_offset),
+      qr, kr, vr, dor, lser, deltar)
+
+    dq = _merge_heads(dq[:, :Tq], B, H)
+    dk = _merge_heads(dk, B, H)
+    dv = _merge_heads(dv, B, H)
+    return dq, dk, dv
 
 
 def merge_partials(carry, partial):

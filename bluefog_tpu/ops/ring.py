@@ -95,7 +95,7 @@ def ring_attention(
 
 
 def _pallas_forward(q, k, v, axis: Axis, causal: bool, scale: float,
-                    block_q: int = 512):
+                    block_q: int = 512, return_lse: bool = False):
     from . import pallas_attention as pa
     n = lax.axis_size(axis)
     idx = lax.axis_index(axis)
@@ -117,34 +117,70 @@ def _pallas_forward(q, k, v, axis: Axis, causal: bool, scale: float,
         vt = lax.ppermute(vt, axis, perm=perm_p)
         return (o, l, m, kt, vt), None
 
-    (o, l, _, _, _), _ = lax.scan(pstep, (o0, l0, m0, k, v), jnp.arange(n))
-    l = jnp.where(l == 0.0, 1.0, l)
-    return (o / l[..., None]).astype(q.dtype)
+    (o, l, m, _, _), _ = lax.scan(pstep, (o0, l0, m0, k, v), jnp.arange(n))
+    denom = jnp.where(l == 0.0, 1.0, l)
+    out = (o / denom[..., None]).astype(q.dtype)
+    if not return_lse:
+        return out
+    # global softmax statistic per q row, consumed by the backward kernel
+    lse = jnp.where(l == 0.0, -jnp.inf, m + jnp.log(denom))
+    return out, lse
 
 
 @partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def _pallas_ring_attention(q, k, v, axis: Axis, causal: bool, scale: float,
                            block_q: int = 512):
-    """Pallas forward with a recompute backward.
+    """Pallas forward with a Pallas flash backward.
 
-    The kernel has no VJP rule, so the backward differentiates the pure-jnp
-    ring path instead (mathematically the same function): forward keeps the
-    score matrix in VMEM; backward recomputes blockwise in jnp — standard
-    flash-attention recompute, paid only when training.
+    Forward keeps each block's score tile in VMEM and saves only
+    ``(q, k, v, o, lse)``; backward recomputes scores blockwise in a second
+    Pallas kernel (FlashAttention-2 recurrence) and runs its own ring pass in
+    which the dk/dv accumulators rotate *with* the K/V blocks, arriving home
+    fully reduced after n steps — no [T, T] matrix ever exists in HBM in
+    either direction.
     """
     return _pallas_forward(q, k, v, axis, causal, scale, block_q)
 
 
 def _pallas_ring_fwd(q, k, v, axis, causal, scale, block_q=512):
-    return _pallas_forward(q, k, v, axis, causal, scale, block_q), (q, k, v)
+    out, lse = _pallas_forward(
+        q, k, v, axis, causal, scale, block_q, return_lse=True)
+    return out, (q, k, v, out, lse)
 
 
 def _pallas_ring_bwd(axis, causal, scale, block_q, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: _jnp_ring_attention(q_, k_, v_, axis, causal, scale),
-        q, k, v)
-    return vjp(g)
+    from . import pallas_attention as pa
+    q, k, v, out, lse = res
+    n = lax.axis_size(axis)
+    idx = lax.axis_index(axis)
+    blk_q, blk_k = q.shape[1], k.shape[1]
+    perm_p = _ring_perm(n, 1)
+
+    do = g.astype(jnp.float32)
+    delta = jnp.sum(do * out.astype(jnp.float32), axis=-1)   # [B, Tq, H]
+    dq0 = lax.pcast(jnp.zeros(q.shape, jnp.float32), axis, to='varying')
+    dk0 = lax.pcast(jnp.zeros(k.shape, jnp.float32), axis, to='varying')
+    dv0 = lax.pcast(jnp.zeros(v.shape, jnp.float32), axis, to='varying')
+
+    def bstep(carry, t):
+        dq, kt, vt, dkt, dvt = carry
+        src = (idx - t) % n
+        dq_p, dk_p, dv_p = pa.attention_block_backward(
+            q, kt, vt, do, lse, delta, idx * blk_q, src * blk_k,
+            causal=causal, scale=scale, block_q=block_q)
+        dq = dq + dq_p
+        dkt = dkt + dk_p
+        dvt = dvt + dv_p
+        # dk/dv accumulators travel with their K/V block around the ring
+        kt = lax.ppermute(kt, axis, perm=perm_p)
+        vt = lax.ppermute(vt, axis, perm=perm_p)
+        dkt = lax.ppermute(dkt, axis, perm=perm_p)
+        dvt = lax.ppermute(dvt, axis, perm=perm_p)
+        return (dq, kt, vt, dkt, dvt), None
+
+    (dq, _, _, dk, dv), _ = lax.scan(
+        bstep, (dq0, k, v, dk0, dv0), jnp.arange(n))
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
 _pallas_ring_attention.defvjp(_pallas_ring_fwd, _pallas_ring_bwd)

@@ -23,6 +23,7 @@ class RingTransformerBlock(nn.Module):
     axis: Optional[str] = None          # mesh axis the sequence is sharded over
     dtype: Any = jnp.bfloat16
     use_pallas: bool = False            # VMEM flash kernel for the attention
+    pallas_interpret: Optional[bool] = None   # override backend auto-detect
 
     @nn.compact
     def __call__(self, x):
@@ -37,7 +38,8 @@ class RingTransformerBlock(nn.Module):
         v = v.reshape(B, T, H, C // H)
         if self.axis is not None:
             att = ring_attention(q, k, v, axis=self.axis, causal=True,
-                                 use_pallas=self.use_pallas)
+                                 use_pallas=self.use_pallas,
+                                 pallas_interpret=self.pallas_interpret)
         else:
             # single-device fallback: dense causal attention
             s = jnp.einsum("bihd,bjhd->bihj", q.astype(jnp.float32),
@@ -73,6 +75,7 @@ class RingTransformerLM(nn.Module):
     dtype: Any = jnp.bfloat16
     remat: bool = False     # rematerialize blocks: trade FLOPs for HBM
     use_pallas: bool = False
+    pallas_interpret: Optional[bool] = None
 
     @nn.compact
     def __call__(self, tokens, pos_offset=0):
@@ -88,7 +91,8 @@ class RingTransformerLM(nn.Module):
         for _ in range(self.num_layers):
             x = Block(
                 num_heads=self.num_heads, axis=self.axis, dtype=self.dtype,
-                use_pallas=self.use_pallas)(x)
+                use_pallas=self.use_pallas,
+                pallas_interpret=self.pallas_interpret)(x)
         x = nn.LayerNorm(dtype=jnp.float32)(x)
         return nn.Dense(self.vocab_size, use_bias=False,
                         dtype=jnp.float32)(x)

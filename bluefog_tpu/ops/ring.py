@@ -66,6 +66,7 @@ def ring_attention(
     scale: Optional[float] = None,
     use_pallas: bool = False,
     pallas_block_q: int = 512,
+    pallas_interpret: Optional[bool] = None,
 ) -> jax.Array:
     """Exact attention over a sequence sharded along ``axis``.
 
@@ -78,6 +79,9 @@ def ring_attention(
     ``use_pallas`` computes each block's partial with the VMEM flash kernel
     (:mod:`bluefog_tpu.ops.pallas_attention`) — scores never touch HBM; on
     non-TPU backends the kernel interprets (use for tests only).
+    ``pallas_interpret`` overrides the auto-detection (which keys off
+    ``jax.default_backend()``): pass ``False`` when AOT-compiling for a TPU
+    topology from a CPU host, where the default backend is not the target.
     """
     if q.ndim != 4:
         raise ValueError("expected [batch, block_len, heads, head_dim]")
@@ -90,12 +94,14 @@ def ring_attention(
 
     if use_pallas:
         return _pallas_ring_attention(
-            q, k, v, axis, causal, float(scale), pallas_block_q)
+            q, k, v, axis, causal, float(scale), pallas_block_q,
+            pallas_interpret)
     return _jnp_ring_attention(q, k, v, axis, causal, float(scale))
 
 
 def _pallas_forward(q, k, v, axis: Axis, causal: bool, scale: float,
-                    block_q: int = 512, return_lse: bool = False):
+                    block_q: int = 512, interpret: Optional[bool] = None,
+                    return_lse: bool = False):
     from . import pallas_attention as pa
     n = lax.axis_size(axis)
     idx = lax.axis_index(axis)
@@ -111,7 +117,7 @@ def _pallas_forward(q, k, v, axis: Axis, causal: bool, scale: float,
         src = (idx - t) % n
         part = pa.attention_block_partial(
             q, kt, vt, idx * blk_q, src * blk_k,
-            causal=causal, scale=scale, block_q=block_q)
+            causal=causal, scale=scale, block_q=block_q, interpret=interpret)
         o, l, m = pa.merge_partials((o, l, m), part)
         kt = lax.ppermute(kt, axis, perm=perm_p)
         vt = lax.ppermute(vt, axis, perm=perm_p)
@@ -127,9 +133,10 @@ def _pallas_forward(q, k, v, axis: Axis, causal: bool, scale: float,
     return out, lse
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _pallas_ring_attention(q, k, v, axis: Axis, causal: bool, scale: float,
-                           block_q: int = 512):
+                           block_q: int = 512,
+                           interpret: Optional[bool] = None):
     """Pallas forward with a Pallas flash backward.
 
     Forward keeps each block's score tile in VMEM and saves only
@@ -139,16 +146,17 @@ def _pallas_ring_attention(q, k, v, axis: Axis, causal: bool, scale: float,
     fully reduced after n steps — no [T, T] matrix ever exists in HBM in
     either direction.
     """
-    return _pallas_forward(q, k, v, axis, causal, scale, block_q)
+    return _pallas_forward(q, k, v, axis, causal, scale, block_q, interpret)
 
 
-def _pallas_ring_fwd(q, k, v, axis, causal, scale, block_q=512):
+def _pallas_ring_fwd(q, k, v, axis, causal, scale, block_q=512,
+                     interpret=None):
     out, lse = _pallas_forward(
-        q, k, v, axis, causal, scale, block_q, return_lse=True)
+        q, k, v, axis, causal, scale, block_q, interpret, return_lse=True)
     return out, (q, k, v, out, lse)
 
 
-def _pallas_ring_bwd(axis, causal, scale, block_q, res, g):
+def _pallas_ring_bwd(axis, causal, scale, block_q, interpret, res, g):
     from . import pallas_attention as pa
     q, k, v, out, lse = res
     n = lax.axis_size(axis)
@@ -167,7 +175,7 @@ def _pallas_ring_bwd(axis, causal, scale, block_q, res, g):
         src = (idx - t) % n
         dq_p, dk_p, dv_p = pa.attention_block_backward(
             q, kt, vt, do, lse, delta, idx * blk_q, src * blk_k,
-            causal=causal, scale=scale, block_q=block_q)
+            causal=causal, scale=scale, block_q=block_q, interpret=interpret)
         dq = dq + dq_p
         dkt = dkt + dk_p
         dvt = dvt + dv_p

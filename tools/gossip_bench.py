@@ -23,6 +23,11 @@ def main():
     parser.add_argument("--params", type=int, default=1 << 20,
                         help="elements per rank in the gossip buffer")
     parser.add_argument("--iters", type=int, default=10)
+    parser.add_argument("--train-step", action="store_true",
+                        help="also compare full CTA train-step time by "
+                             "communicator: fused / unfused / empty / "
+                             "allreduce (overlap + fusion cost on this "
+                             "backend)")
     args = parser.parse_args()
 
     if args.virtual_cpu:
@@ -90,6 +95,61 @@ def main():
     for name, rounds, deg, ms in rows:
         print(f"{name:>22} {rounds:>7} {deg:>18.2f} {ms:>9.2f}")
     print(f"{'global allreduce':>22} {'-':>7} {2 * (n - 1) / n:>18.2f} {ar_ms:>9.2f}")
+
+    if args.train_step:
+        _train_step_comparison(args, bf, n)
+
+
+def _train_step_comparison(args, bf, n):
+    """Full CTA train step (MLP, scan of 4) under different communicators.
+
+    The empty-communicator row is the pure-compute floor; the gap between it
+    and the gossip rows is the *visible* (non-overlapped) communication cost
+    on this backend.  On TPU the async start/done scheduling hides most of it
+    (tests/test_tpu_aot.py proves the schedule); the virtual CPU mesh runs
+    collectives synchronously, so CPU gaps are an upper bound.
+    """
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from bluefog_tpu import optimizers as bfopt
+    from bluefog_tpu import topology as tu
+
+    bf.set_topology(tu.ExponentialTwoGraph(n))
+    dim, bsz, steps = 256, 32, 4
+
+    def grad_fn(params, batch):
+        x, y = batch
+        def loss(p):
+            h = jnp.tanh(x @ p["w1"])
+            return jnp.mean((h @ p["w2"] - y) ** 2)
+        return jax.value_and_grad(loss)(params)
+
+    comms = {
+        "gossip fused": bfopt.neighbor_communicator(bf.static_schedule()),
+        "gossip unfused": bfopt.neighbor_communicator(
+            bf.static_schedule(), fuse=False),
+        "no comm (floor)": bfopt.empty_communicator(),
+        "global allreduce": bfopt.allreduce_communicator(),
+    }
+    print(f"\nCTA train step (MLP {dim}x{dim}x2, batch {bsz}, scan {steps}) "
+          f"by communicator:")
+    print(f"{'communicator':>22} {'ms/step':>9}")
+    for name, comm in comms.items():
+        strat = bfopt.adapt_with_combine(optax.sgd(0.01), comm)
+        params = bfopt.replicate({"w1": jnp.zeros((dim, dim)),
+                                  "w2": jnp.zeros((dim, dim))})
+        state = bfopt.init_distributed(strat, params)
+        step = bfopt.make_train_step(grad_fn, strat, steps_per_call=steps)
+        batch = tuple(jnp.zeros((n, steps, bsz, dim)) for _ in range(2))
+        params, state, loss = step(params, state, batch)   # compile
+        jax.block_until_ready(loss)
+        t0 = time.perf_counter()
+        for _ in range(args.iters):
+            params, state, loss = step(params, state, batch)
+            jax.block_until_ready(loss)
+        ms = (time.perf_counter() - t0) / (args.iters * steps) * 1e3
+        print(f"{name:>22} {ms:>9.2f}")
 
 
 if __name__ == "__main__":

@@ -37,6 +37,7 @@ test_examples:
 	$(PY) examples/benchmark.py --virtual-cpu --model mlp --num-iters 3 \
 		--dist-optimizer allreduce
 	$(PY) examples/long_context.py --virtual-cpu --steps 10
+	$(PY) examples/pipeline_lm.py --virtual-cpu --steps 30
 
 # build the native (C++) components explicitly (otherwise built lazily)
 native:

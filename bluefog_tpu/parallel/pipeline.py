@@ -12,6 +12,15 @@ The schedule is the classic GPipe loop unrolled as ``lax.scan`` over
 device executes every tick (SPMD), with out-of-range ticks masked — the
 bubble is the standard ``(S-1)/(M+S-1)`` overhead.
 
+**Training**: every op in the schedule is differentiable (``ppermute``
+transposes to the reverse permute), so ``jax.grad`` through
+:func:`pipeline_apply` IS the backward pipeline: cotangents enter at the
+last stage and flow stage-to-stage upstream in reverse tick order, exactly
+GPipe's backward schedule.  Gradients match the sequential composition to
+float tolerance (``tests/test_pipeline.py``).  ``remat=True`` recomputes
+each stage's forward inside the backward (activation memory drops from
+O(ticks) to O(1) stash per stage — GPipe's standard trade).
+
 Composable with gossip DP: put ``stage`` next to ``rank`` on a 2-D mesh and
 gossip each stage's parameters over ``rank`` as usual.
 """
@@ -23,7 +32,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-__all__ = ["pipeline_apply"]
+__all__ = ["pipeline_apply", "last_stage_value"]
 
 Axis = str
 
@@ -34,6 +43,7 @@ def pipeline_apply(
     microbatches: jax.Array,
     *,
     axis: Axis = "stage",
+    remat: bool = False,
 ) -> jax.Array:
     """Run a stage-partitioned network over microbatches.
 
@@ -44,12 +54,17 @@ def pipeline_apply(
       microbatches: ``[num_micro, ...]`` input microbatches.  Only stage 0
         reads them; other stages receive activations from their predecessor.
       axis: the mesh axis stages live on.
+      remat: rematerialize each stage's forward during the backward pass
+        instead of stashing per-tick activations.
 
     Returns:
       ``[num_micro, ...]`` outputs of the LAST stage (other stages return
       zeros of the same shape — select by ``lax.axis_index(axis)`` outside,
       or psum if only the final value is consumed).
     """
+    if remat:
+        stage_fn = jax.checkpoint(
+            stage_fn, policy=jax.checkpoint_policies.nothing_saveable)
     n_stage = lax.axis_size(axis)
     sid = lax.axis_index(axis)
     num_micro = microbatches.shape[0]
@@ -90,3 +105,16 @@ def pipeline_apply(
     (_, outputs), _ = lax.scan(
         tick, (inbox0, outputs0), jnp.arange(ticks))
     return outputs
+
+
+def last_stage_value(x: jax.Array, *, axis: Axis = "stage") -> jax.Array:
+    """Replicate the LAST stage's value to every stage (for loss/eval).
+
+    :func:`pipeline_apply` returns real outputs on the last stage and zeros
+    elsewhere; this masks and ``psum``s so all stages hold the result.  Its
+    gradient routes cotangents exclusively to the last stage's copy, so a
+    loss built on the returned value backpropagates into the pipeline once.
+    """
+    sid = lax.axis_index(axis)
+    n = lax.axis_size(axis)
+    return lax.psum(jnp.where(sid == n - 1, x, jnp.zeros_like(x)), axis)

@@ -1,11 +1,13 @@
-"""Pipeline parallelism: staged execution == sequential composition."""
+"""Pipeline parallelism: staged execution == sequential composition,
+forward AND backward (autodiff through the schedule is the GPipe backward)."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import optax
 import pytest
 from jax.sharding import Mesh, PartitionSpec as P
 
-from bluefog_tpu.parallel.pipeline import pipeline_apply
+from bluefog_tpu.parallel.pipeline import last_stage_value, pipeline_apply
 
 S = 4       # stages
 M = 6       # microbatches
@@ -54,3 +56,105 @@ def test_pipeline_single_microbatch(cpu_devices):
     out = _run_pipeline(cpu_devices, stage_fn, {"w": w, "b": b}, mb)
     np.testing.assert_allclose(
         out[0], np.full((B, D), 1.0 * 2 * 3 * 4), rtol=1e-6)
+
+
+def _pipeline_grads(cpu_devices, remat=False):
+    """Loss + per-stage grads of an MSE objective through the pipeline."""
+    mesh = Mesh(np.array(cpu_devices[:S]), ("stage",))
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.normal(size=(S, D, D)) * 0.5, jnp.float32)
+    b = jnp.asarray(rng.normal(size=(S, D)) * 0.1, jnp.float32)
+    mb = jnp.asarray(rng.normal(size=(M, B, D)), jnp.float32)
+    tgt = jnp.asarray(rng.normal(size=(M, B, D)), jnp.float32)
+
+    def stage_fn(p, x):
+        return jnp.tanh(x @ p["w"][0] + p["b"][0])
+
+    def loss_fn(params, mbs, tgts):
+        out = pipeline_apply(stage_fn, params, mbs[0], axis="stage",
+                             remat=remat)
+        out = last_stage_value(out, axis="stage")
+        return jnp.mean((out - tgts[0]) ** 2)
+
+    def f(params, mbs, tgts):
+        l, g = jax.value_and_grad(loss_fn)(params, mbs, tgts)
+        return jax.tree.map(lambda x: x[None], g), l[None]
+
+    fn = jax.jit(jax.shard_map(
+        f, mesh=mesh, in_specs=(P("stage"), P(None), P(None)),
+        out_specs=(P("stage"), P("stage"))))
+    g, l = fn({"w": w, "b": b}, mb[None], tgt[None])
+
+    def seq_loss(params):
+        x = mb
+        for s in range(S):
+            x = jnp.tanh(x @ params["w"][s] + params["b"][s])
+        return jnp.mean((x - tgt) ** 2)
+
+    lo, go = jax.value_and_grad(seq_loss)({"w": w, "b": b})
+    return (np.asarray(l)[0], g), (float(lo), go)
+
+
+@pytest.mark.parametrize("remat", [False, True])
+def test_pipeline_grads_match_sequential(cpu_devices, remat):
+    """Autodiff through the GPipe schedule == sequential-composition grads,
+    for every stage's parameters, with and without remat."""
+    (l, g), (lo, go) = _pipeline_grads(cpu_devices, remat=remat)
+    assert abs(l - lo) < 1e-6
+    for s in range(S):
+        for key in ("w", "b"):
+            np.testing.assert_allclose(
+                np.asarray(g[key][s][0]), np.asarray(go[key][s]),
+                rtol=1e-4, atol=1e-6, err_msg=f"stage {s} {key}")
+
+
+def test_pipeline_trains_to_decreasing_loss(cpu_devices):
+    """A 4-stage pipelined MLP trains end-to-end: loss strictly decreases
+    and beats its start by a wide margin (the round-1 gap: pipeline was
+    forward-only in practice)."""
+    mesh = Mesh(np.array(cpu_devices[:S]), ("stage",))
+    rng = np.random.default_rng(3)
+    params = {
+        "w": jnp.asarray(rng.normal(size=(S, D, D)) * 0.5, jnp.float32),
+        "b": jnp.zeros((S, D), jnp.float32),
+    }
+    # learnable target map: a fixed random 4-layer net (student-teacher)
+    tw = jnp.asarray(rng.normal(size=(S, D, D)) * 0.5, jnp.float32)
+    x_all = jnp.asarray(rng.normal(size=(64, B, D)), jnp.float32)
+    y_all = x_all
+    for s in range(S):
+        y_all = jnp.tanh(y_all @ tw[s])
+
+    opt = optax.adam(3e-3)
+
+    def stage_fn(p, x):
+        return jnp.tanh(x @ p["w"][0] + p["b"][0])
+
+    def loss_fn(params, mbs, tgts):
+        out = pipeline_apply(stage_fn, params, mbs[0], axis="stage")
+        out = last_stage_value(out, axis="stage")
+        return jnp.mean((out - tgts[0]) ** 2)
+
+    def train_step(params, opt_state, mbs, tgts):
+        l, g = jax.value_and_grad(loss_fn)(params, mbs, tgts)
+        updates, opt_state = opt.update(g, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, l[None]
+
+    # optimizer state is stage-local like the params; scalars (adam's step
+    # count) stay replicated
+    opt_state = opt.init(params)
+    opt_spec = jax.tree.map(lambda x: P("stage") if x.ndim else P(), opt_state)
+    fn = jax.jit(jax.shard_map(
+        train_step, mesh=mesh,
+        in_specs=(P("stage"), opt_spec, P(None), P(None)),
+        out_specs=(P("stage"), opt_spec, P("stage"))))
+
+    losses = []
+    for it in range(120):
+        sel = (np.arange(M) + it * M) % 64
+        mbs, tgts = x_all[sel], y_all[sel]
+        params, opt_state, l = fn(params, opt_state, mbs[None], tgts[None])
+        losses.append(float(jax.block_until_ready(l)[0]))
+    assert losses[-1] < 0.4 * losses[0], losses[::20]
+    assert np.mean(losses[-10:]) < np.mean(losses[:10])

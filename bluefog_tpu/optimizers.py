@@ -261,6 +261,71 @@ def adapt_then_combine(
     return DecentralizedOptimizer(init, update, axes)
 
 
+def _mailbox_optimizer(
+    opt: optax.GradientTransformation,
+    sched: Optional[CommSchedule],
+    leaf_comm,
+    *,
+    axis: Axis,
+    num_steps_per_communication: int,
+    fuse: bool,
+    carry_windows: bool,
+) -> DecentralizedOptimizer:
+    """Shared scaffold for window (mailbox) gossip strategies.
+
+    ``leaf_comm(s, window, x) -> new Window`` is the per-buffer gossip round;
+    ``carry_windows`` keeps the mailboxes in ``comm_state`` across steps
+    (push pipelines read last step's deliveries) or rebuilds them locally
+    each communication (pull pipelines overwrite them anyway — carrying
+    them would just pin ``max_in_degree`` dead parameter copies in HBM).
+    """
+    k = num_steps_per_communication
+
+    def _sched():
+        return sched if sched is not None else _mesh.static_schedule()
+
+    def _fused(params):
+        return fusion.fuse_tree(params).buffers if fuse else params
+
+    def init(params):
+        windows = jax.tree.map(
+            lambda x: wops.win_create(x, _sched(), zero_init=False),
+            _fused(params)) if carry_windows else None
+        return DecentralizedState(
+            jnp.zeros((), jnp.int32), opt.init(params), windows)
+
+    def update(grads, state, params):
+        s = _sched()
+        ft = fusion.fuse_tree(params) if fuse else None
+        comm_input = ft.buffers if fuse else params
+
+        def communicate(operand):
+            values, windows = operand
+            if carry_windows:
+                new_windows = _map_windows(
+                    lambda w, x: leaf_comm(s, w, x, axis), windows, values)
+            else:
+                new_windows = jax.tree.map(
+                    lambda x: leaf_comm(s, wops.win_create(x, s), x, axis),
+                    values)
+            combined = _map_windows(lambda w: w.value, new_windows)
+            return combined, (new_windows if carry_windows else None)
+
+        if k > 1:
+            combined, windows = lax.cond(
+                (state.step + 1) % k == 0, communicate,
+                lambda o: o, (comm_input, state.comm_state))
+        else:
+            combined, windows = communicate((comm_input, state.comm_state))
+        if fuse:
+            ft.buffers = combined
+            combined = ft.unfuse()
+        new_params, opt_state = _apply(opt, grads, state.opt_state, combined)
+        return new_params, DecentralizedState(state.step + 1, opt_state, windows)
+
+    return DecentralizedOptimizer(init, update)
+
+
 def win_put_optimizer(
     opt: optax.GradientTransformation,
     sched: Optional[CommSchedule] = None,
@@ -279,53 +344,51 @@ def win_put_optimizer(
     parameter (the reference creates a window per parameter and pays one RMA
     epoch each; here fusing makes the put one permute chain total).
     """
-    k = num_steps_per_communication
+    def leaf(s, w, x, ax):
+        # combine last step's mailboxes with the current value, then put
+        # the combined value to out-neighbors
+        w = wops.Window(value=x, recv=w.recv)
+        value, w = wops.win_update(w, s, axis=ax)
+        return wops.win_put(w, value, s, axis=ax)
 
-    def _sched():
-        return sched if sched is not None else _mesh.static_schedule()
+    return _mailbox_optimizer(
+        opt, sched, leaf, axis=axis,
+        num_steps_per_communication=num_steps_per_communication,
+        fuse=fuse, carry_windows=True)
 
-    def _fused(params):
-        return fusion.fuse_tree(params).buffers if fuse else params
 
-    def init(params):
-        windows = jax.tree.map(
-            lambda x: wops.win_create(x, _sched(), zero_init=False),
-            _fused(params))
-        return DecentralizedState(
-            jnp.zeros((), jnp.int32), opt.init(params), windows)
+def pull_get_optimizer(
+    opt: optax.GradientTransformation,
+    sched: Optional[CommSchedule] = None,
+    *,
+    axis: Axis = "rank",
+    num_steps_per_communication: int = 1,
+    fuse: bool = True,
+) -> DecentralizedOptimizer:
+    """Pull-based gossip: fetch neighbors' CURRENT params, combine, adapt.
 
-    def update(grads, state, params):
-        s = _sched()
-        ft = fusion.fuse_tree(params) if fuse else None
-        comm_input = ft.buffers if fuse else params
+    Reference: ``DistributedPullGetOptimizer`` (``optimizers.py:911-931``).
+    The staleness profile is what distinguishes pull from push: a ``win_get``
+    fetches the value the neighbor holds *now* (zero steps stale under
+    lockstep SPMD), whereas :func:`win_put_optimizer` combines what neighbors
+    pushed *last* step (one step stale).  The two trajectories genuinely
+    differ (``tests/test_optimizers.py::test_pull_get_differs_from_win_put``);
+    pull-with-fresh-values coincides with combine-then-adapt on the current
+    params, which the tests pin as its oracle.  The mailboxes are rebuilt
+    inside each communication (``carry_windows=False``): a pull overwrites
+    them before reading, so persisting them would only waste HBM.
+    """
+    def leaf(s, w, x, ax):
+        # publish the current value, pull in-neighbors' current values
+        # into the mailboxes, combine fresh
+        w = wops.win_get(w, s, axis=ax)
+        _, w = wops.win_update(w, s, axis=ax)
+        return w
 
-        def communicate(operand):
-            values, windows = operand
-
-            def leaf(w, x):
-                # combine last step's mailboxes with the current value,
-                # then put the combined value to out-neighbors
-                w = wops.Window(value=x, recv=w.recv)
-                value, w = wops.win_update(w, s, axis=axis)
-                return wops.win_put(w, value, s, axis=axis)
-
-            new_windows = _map_windows(leaf, windows, values)
-            combined = _map_windows(lambda w: w.value, new_windows)
-            return combined, new_windows
-
-        if k > 1:
-            combined, windows = lax.cond(
-                (state.step + 1) % k == 0, communicate,
-                lambda o: o, (comm_input, state.comm_state))
-        else:
-            combined, windows = communicate((comm_input, state.comm_state))
-        if fuse:
-            ft.buffers = combined
-            combined = ft.unfuse()
-        new_params, opt_state = _apply(opt, grads, state.opt_state, combined)
-        return new_params, DecentralizedState(state.step + 1, opt_state, windows)
-
-    return DecentralizedOptimizer(init, update)
+    return _mailbox_optimizer(
+        opt, sched, leaf, axis=axis,
+        num_steps_per_communication=num_steps_per_communication,
+        fuse=fuse, carry_windows=False)
 
 
 def push_sum(
@@ -516,13 +579,7 @@ def DistributedWinPutOptimizer(opt, **kw):
 
 
 def DistributedPullGetOptimizer(opt, **kw):
-    """Pull-based mailbox gossip (reference: ``DistributedPullGetOptimizer``).
-
-    Under SPMD a pull is the mirror image of a push (see
-    ``ops.windows.win_get``); the optimizer is therefore the same pipeline as
-    ``win_put_optimizer`` with get-delivery, which is identical in effect.
-    """
-    return win_put_optimizer(opt, **kw)
+    return pull_get_optimizer(opt, **kw)
 
 
 def DistributedPushSumOptimizer(opt, **kw):

@@ -18,8 +18,10 @@ transposes to the reverse permute), so ``jax.grad`` through
 last stage and flow stage-to-stage upstream in reverse tick order, exactly
 GPipe's backward schedule.  Gradients match the sequential composition to
 float tolerance (``tests/test_pipeline.py``).  ``remat=True`` recomputes
-each stage's forward inside the backward (activation memory drops from
-O(ticks) to O(1) stash per stage — GPipe's standard trade).
+each stage's forward inside the backward, shrinking the per-tick stash from
+the stage's full intermediates (attention scores, MLP activations) to just
+the stage *input* — the scan still keeps one input per tick, GPipe's
+standard trade.
 
 Composable with gossip DP: put ``stage`` next to ``rank`` on a 2-D mesh and
 gossip each stage's parameters over ``rank`` as usual.
@@ -54,8 +56,9 @@ def pipeline_apply(
       microbatches: ``[num_micro, ...]`` input microbatches.  Only stage 0
         reads them; other stages receive activations from their predecessor.
       axis: the mesh axis stages live on.
-      remat: rematerialize each stage's forward during the backward pass
-        instead of stashing per-tick activations.
+      remat: rematerialize each stage's forward during the backward pass,
+        stashing only the per-tick stage inputs instead of all stage
+        intermediates.
 
     Returns:
       ``[num_micro, ...]`` outputs of the LAST stage (other stages return

@@ -28,7 +28,7 @@ def main():
     parser.add_argument("--dist-optimizer", default="neighbor_allreduce",
                         choices=["neighbor_allreduce", "gradient_allreduce",
                                  "allreduce", "hierarchical_neighbor_allreduce",
-                                 "win_put", "push_sum", "empty"])
+                                 "win_put", "pull_get", "push_sum", "empty"])
     parser.add_argument("--atc", action="store_true")
     parser.add_argument("--dynamic-topology", action="store_true")
     parser.add_argument("--batch-size", type=int, default=32)
@@ -140,6 +140,8 @@ def main():
         strategy = bfopt.gradient_allreduce(opt)
     elif name == "win_put":
         strategy = bfopt.DistributedWinPutOptimizer(opt)
+    elif name == "pull_get":
+        strategy = bfopt.DistributedPullGetOptimizer(opt)
     elif name == "push_sum":
         strategy = bfopt.DistributedPushSumOptimizer(opt)
     else:

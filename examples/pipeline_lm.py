@@ -45,6 +45,9 @@ def main():
     if args.lag < 1:
         parser.error("--lag must be >= 1 (predicting the current token "
                      "would be trivial)")
+    if args.d_model % args.heads:
+        parser.error(f"--d-model {args.d_model} must be divisible by "
+                     f"--heads {args.heads}")
 
     if args.virtual_cpu:
         flags = os.environ.get("XLA_FLAGS", "")

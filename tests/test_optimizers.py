@@ -131,6 +131,48 @@ def test_win_put_optimizer():
     _check(w, w_opt)
 
 
+def test_pull_get_optimizer_converges():
+    strat = bfopt.DistributedPullGetOptimizer(optax.sgd(0.05))
+    w, w_opt = _run(strat)
+    _check(w, w_opt)
+
+
+def _trajectory(strategy, steps=6, seed=0):
+    """Per-step parameter snapshots (steps_per_call=1 so staleness shows)."""
+    A, b, _ = _problem(seed)
+    params = {"w": jnp.asarray(
+        np.random.default_rng(seed + 1).normal(size=(N, D)), jnp.float32)}
+    state = bfopt.init_distributed(strategy, params)
+    step = bfopt.make_train_step(grad_fn, strategy, steps_per_call=1)
+    snaps = []
+    for _ in range(steps):
+        params, state, loss = step(params, state, (A, b))
+        jax.block_until_ready(loss)
+        snaps.append(np.asarray(params["w"]).copy())
+    return snaps
+
+
+def test_pull_get_differs_from_win_put():
+    """Pull combines neighbors' CURRENT values; push combines what they sent
+    last step (one-step stale).  From identical starts the trajectories must
+    separate — the round-1 shim aliased them (reference distinguishes the
+    two: optimizers.py:850-1005 vs 911-931)."""
+    pull = _trajectory(bfopt.pull_get_optimizer(optax.sgd(0.05)))
+    push = _trajectory(bfopt.win_put_optimizer(optax.sgd(0.05)))
+    diffs = [np.abs(a - b).max() for a, b in zip(pull, push)]
+    assert max(diffs) > 1e-3, diffs
+
+
+def test_pull_get_matches_fresh_combine_oracle():
+    """With zero staleness, pull-then-adapt IS combine-then-adapt on current
+    params: the window pipeline must reproduce the CTA trajectory exactly."""
+    pull = _trajectory(bfopt.pull_get_optimizer(optax.sgd(0.05)))
+    cta = _trajectory(bfopt.adapt_with_combine(
+        optax.sgd(0.05), bfopt.neighbor_communicator(bf.static_schedule())))
+    for a, b in zip(pull, cta):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+
 def test_push_sum_optimizer():
     # directed ring: column-substochastic without correction; push-sum fixes it
     bf.set_topology(tu.RingGraph(N, connect_style=2))

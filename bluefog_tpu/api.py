@@ -226,14 +226,24 @@ def ragged_neighbor_allgather(
     """
     ctx = _mesh.get_context()
     _check_distributed(x, ctx.size)
-    lengths = jnp.asarray(lengths, jnp.int32).reshape(ctx.size, 1)
-    gathered = neighbor_allgather(
-        x, self_weight=self_weight, src_weights=src_weights,
-        dst_weights=dst_weights, schedule=schedule)
-    glens = neighbor_allgather(
-        lengths, self_weight=self_weight, src_weights=src_weights,
-        dst_weights=dst_weights, schedule=schedule)
-    return gathered, glens.reshape(ctx.size, -1)
+    if x.ndim < 2:
+        raise ValueError("ragged_neighbor_allgather needs a per-rank first "
+                         "dimension")
+    lengths = jnp.asarray(lengths, jnp.int32).reshape(ctx.size)
+    sched = resolve_schedule(self_weight, src_weights, dst_weights, schedule)
+
+    def per_rank(xb, lb):
+        # one collective chain: the length channel rides in the data buffer
+        data, lens = ops.ragged_neighbor_allgather(
+            xb[0], lb[0], sched, axis="rank")
+        return data[None], lens[None]
+
+    fn = _cached(
+        ("rnag", sched, ctx.mesh, x.shape, x.dtype.name),
+        lambda: jax.jit(jax.shard_map(
+            per_rank, mesh=ctx.mesh, in_specs=(P("rank"), P("rank")),
+            out_specs=(P("rank"), P("rank")))))
+    return fn(x, lengths)
 
 
 def allreduce(x: jax.Array, average: bool = True) -> jax.Array:

@@ -9,6 +9,7 @@ from .collectives import (
     my_rank,
     neighbor_allreduce,
     neighbor_allgather,
+    ragged_neighbor_allgather,
     allreduce,
     allgather,
     broadcast,
@@ -21,6 +22,7 @@ __all__ = [
     "my_rank",
     "neighbor_allreduce",
     "neighbor_allgather",
+    "ragged_neighbor_allgather",
     "allreduce",
     "allgather",
     "broadcast",

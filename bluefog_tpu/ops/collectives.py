@@ -96,6 +96,68 @@ def neighbor_allgather(
     return out
 
 
+def ragged_neighbor_allgather(
+    x: jax.Array,
+    length: jax.Array,
+    sched: CommSchedule,
+    *,
+    axis: Axis = "rank",
+) -> Tuple[jax.Array, jax.Array]:
+    """Neighbor allgather of padded ragged slices — ONE collective chain.
+
+    ``x`` is ``[max_d0, ...]`` with this rank's valid rows ``x[:length]``.
+    The 4-byte length channel rides inside the same permuted buffer as the
+    data (everything is bitcast to bytes, the length appended as one extra
+    row), instead of paying a second full permute chain for 4 bytes the way
+    two separate allgathers would.  The reference pre-negotiates sizes over
+    its control channel (``mpi_context.cc:504-630``); under SPMD the length
+    is just payload.
+
+    Returns ``(gathered [max_in_degree * max_d0, ...], lengths
+    [max_in_degree])`` sorted by source rank, zero-padded on ranks with
+    smaller in-degree.
+    """
+    orig_dtype = x.dtype
+    if x.dtype == jnp.bool_:
+        # bitcast rejects bool; a 0/1 byte round-trips exactly
+        x = x.astype(jnp.uint8)
+    elif jnp.issubdtype(x.dtype, jnp.complexfloating):
+        f = jnp.float64 if x.dtype == jnp.complex128 else jnp.float32
+        x = jnp.stack([x.real.astype(f), x.imag.astype(f)], axis=-1)
+
+    d0 = x.shape[0]
+    row = int(np.prod(x.shape[1:], dtype=np.int64)) if x.ndim > 1 else 1
+    itemsize = jnp.dtype(x.dtype).itemsize
+    row_b = max(row * itemsize, 1)
+    W = max(row_b, 4)
+
+    xb = lax.bitcast_convert_type(x.reshape(d0, -1), jnp.uint8)
+    xb = xb.reshape(d0, row_b)
+    if W > row_b:
+        xb = jnp.pad(xb, ((0, 0), (0, W - row_b)))
+    lb = lax.bitcast_convert_type(
+        jnp.asarray(length, jnp.int32).reshape(1), jnp.uint8).reshape(1, 4)
+    if W > 4:
+        lb = jnp.pad(lb, ((0, 0), (0, W - 4)))
+    buf = jnp.concatenate([xb, lb], axis=0)              # [d0 + 1, W]
+
+    gathered = neighbor_allgather(buf, sched, axis=axis)
+    slots = max(sched.max_in_degree, 1)
+    g = gathered.reshape(slots, d0 + 1, W)
+
+    data = g[:, :d0, :row_b].reshape(slots * d0, row, itemsize)
+    if itemsize == 1:
+        data = data[..., 0]
+    data = lax.bitcast_convert_type(data, x.dtype)
+    data = data.reshape((slots * d0,) + x.shape[1:])
+    if orig_dtype == jnp.bool_:
+        data = data.astype(jnp.bool_)
+    elif jnp.issubdtype(orig_dtype, jnp.complexfloating):
+        data = lax.complex(data[..., 0], data[..., 1]).astype(orig_dtype)
+    lens = lax.bitcast_convert_type(g[:, d0, :4], jnp.int32)   # [slots]
+    return data, lens
+
+
 def allreduce(x: jax.Array, *, average: bool = True, axis: Axis = "rank") -> jax.Array:
     """Global allreduce (reference: ``MPIController::Allreduce``)."""
     return lax.pmean(x, axis) if average else lax.psum(x, axis)
@@ -107,10 +169,32 @@ def allgather(x: jax.Array, *, axis: Axis = "rank") -> jax.Array:
 
 
 def broadcast(x: jax.Array, root_rank: int, *, axis: Axis = "rank") -> jax.Array:
-    """Every device receives root's block (reference: Broadcast)."""
+    """Every device receives root's block (reference: Broadcast).
+
+    Binomial-tree fan-out in ``ceil(log2 n)`` ``ppermute`` rounds: at round k
+    the devices within distance ``2**k`` of the root forward to distance
+    ``2**k`` further.  Compared to the masked-``psum`` formulation (a full
+    allreduce: ~2x bytes in a 2(n-1)-hop latency chain plus a pointless
+    reduction), the tree moves ``log2(n)``x bytes in ``log2(n)`` hops and
+    never reduces — the right shape for ``broadcast_parameters`` restarts,
+    which are latency-bound.
+    """
+    n = lax.axis_size(axis)
     idx = lax.axis_index(axis)
-    masked = jnp.where(idx == root_rank, x, jnp.zeros_like(x))
-    return lax.psum(masked, axis)
+    pos = (idx - root_rank) % n          # distance downstream of the root
+    y = x
+    shift = 1
+    while shift < n:
+        # only the devices that already hold the value send (n-1 block-sends
+        # total across all rounds, the binomial-tree optimum)
+        perm = tuple(((root_rank + j) % n, (root_rank + j + shift) % n)
+                     for j in range(min(shift, n - shift)))
+        recv = lax.ppermute(y, axis, perm=perm)
+        # devices at distance [shift, 2*shift) receive from a device that
+        # already holds the value; everyone else keeps theirs
+        y = jnp.where((pos >= shift) & (pos < 2 * shift), recv, y)
+        shift *= 2
+    return y
 
 
 def pair_gossip(

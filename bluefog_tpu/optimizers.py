@@ -86,9 +86,10 @@ def neighbor_communicator(
                 for s in schedules
             ]
             return lax.switch(step % len(schedules), branches, x)
-        if fuse:
-            return fusion.fused_leaf_op(leaf)(params)
-        return jax.tree.map(leaf, params)
+        with jax.named_scope("COMMUNICATE"):
+            if fuse:
+                return fusion.fused_leaf_op(leaf)(params)
+            return jax.tree.map(leaf, params)
 
     return comm
 
@@ -116,9 +117,10 @@ def hierarchical_communicator(
                 for s in machine_schedules
             ]
             return lax.switch(step % len(machine_schedules), branches, xm)
-        if fuse:
-            return fusion.fused_leaf_op(leaf)(params)
-        return jax.tree.map(leaf, params)
+        with jax.named_scope("COMMUNICATE"):
+            if fuse:
+                return fusion.fused_leaf_op(leaf)(params)
+            return jax.tree.map(leaf, params)
 
     return comm
 
@@ -126,7 +128,8 @@ def hierarchical_communicator(
 def allreduce_communicator(*, axis: Axis = "rank") -> Communicator:
     """Global parameter averaging (reference ``communication_type=allreduce``)."""
     def comm(params, step):
-        return jax.tree.map(lambda x: lax.pmean(x, axis), params)
+        with jax.named_scope("COMMUNICATE"):
+            return jax.tree.map(lambda x: lax.pmean(x, axis), params)
     return comm
 
 
@@ -169,8 +172,12 @@ class DecentralizedOptimizer(NamedTuple):
 
 
 def _apply(opt, grads, opt_state, params):
-    updates, new_opt_state = opt.update(grads, opt_state, params)
-    return optax.apply_updates(params, updates), new_opt_state
+    # named scopes thread into HLO op metadata, so device traces show the
+    # reference's activity names (COMMUNICATE/ADAPT) without user effort
+    # (reference auto-annotation: torch/optimizers.py:112-163)
+    with jax.named_scope("ADAPT"):
+        updates, new_opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), new_opt_state
 
 
 def _map_windows(fn, windows, *rest):
@@ -195,10 +202,11 @@ def gradient_allreduce(
 
     def update(grads, state, params):
         reduce_ = lambda g: lax.pmean(g, axis)
-        if fuse:
-            grads = fusion.fused_leaf_op(reduce_)(grads)
-        else:
-            grads = jax.tree.map(reduce_, grads)
+        with jax.named_scope("COMMUNICATE"):
+            if fuse:
+                grads = fusion.fused_leaf_op(reduce_)(grads)
+            else:
+                grads = jax.tree.map(reduce_, grads)
         new_params, opt_state = _apply(opt, grads, state.opt_state, params)
         return new_params, DecentralizedState(state.step + 1, opt_state)
 
@@ -301,13 +309,14 @@ def _mailbox_optimizer(
 
         def communicate(operand):
             values, windows = operand
-            if carry_windows:
-                new_windows = _map_windows(
-                    lambda w, x: leaf_comm(s, w, x, axis), windows, values)
-            else:
-                new_windows = jax.tree.map(
-                    lambda x: leaf_comm(s, wops.win_create(x, s), x, axis),
-                    values)
+            with jax.named_scope("COMMUNICATE"):
+                if carry_windows:
+                    new_windows = _map_windows(
+                        lambda w, x: leaf_comm(s, w, x, axis), windows, values)
+                else:
+                    new_windows = jax.tree.map(
+                        lambda x: leaf_comm(s, wops.win_create(x, s), x, axis),
+                        values)
             combined = _map_windows(lambda w: w.value, new_windows)
             return combined, (new_windows if carry_windows else None)
 
@@ -451,10 +460,11 @@ def push_sum(
             _, w = wops.win_update_then_collect(w, s, axis=axis)
             return w                      # w.value is the mixed iterate
 
-        windows = _map_windows(gossip, windows)
-        mixed = _map_windows(lambda w: w.value, windows)
-        p_windows = _map_windows(gossip, p_windows)
-        p_new = _map_windows(lambda w: w.value, p_windows)
+        with jax.named_scope("COMMUNICATE"):
+            windows = _map_windows(gossip, windows)
+            mixed = _map_windows(lambda w: w.value, windows)
+            p_windows = _map_windows(gossip, p_windows)
+            p_new = _map_windows(lambda w: w.value, p_windows)
 
         # de-bias, adapt the de-biased iterate, re-bias into the gossip
         # channel so the mass-preserving invariant sum_r x_r = sum_r p_r*z_r
@@ -672,13 +682,15 @@ def make_train_step(
     def per_rank(params, state, batch):
         params, state, batch = jax.tree.map(lambda x: x[0], (params, state, batch))
         if steps_per_call == 1:
-            loss, grads = grad_fn(params, batch)
+            with jax.named_scope("GRADIENT"):
+                loss, grads = grad_fn(params, batch)
             new_params, new_state = strategy.update(grads, state, params)
             return jax.tree.map(lambda x: x[None], (new_params, new_state, loss))
 
         def body(carry, b):
             p, s = carry
-            loss, grads = grad_fn(p, b)
+            with jax.named_scope("GRADIENT"):
+                loss, grads = grad_fn(p, b)
             p, s = strategy.update(grads, s, p)
             return (p, s), loss
 

@@ -80,6 +80,42 @@ def test_timeline_records_activities(tmp_path, cpu_devices):
         bf.shutdown()
 
 
+def test_strategies_auto_emit_activity_names(cpu_devices):
+    """Strategies annotate their phases (COMMUNICATE/ADAPT/GRADIENT) via
+    jax.named_scope, so device traces show the reference's activity spans
+    with zero user effort (reference auto-annotation,
+    torch/optimizers.py:112-163; asserted like timeline_test.py:54-117 but
+    against the lowered program, where the names become op metadata)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from bluefog_tpu import optimizers as bfopt
+
+    bf.init(devices=cpu_devices, nodes_per_machine=1)
+    try:
+        def grad_fn(params, batch):
+            return jax.value_and_grad(
+                lambda p: jnp.mean((p["w"] - batch) ** 2))(params)
+
+        for strat in (
+            bfopt.adapt_with_combine(
+                optax.sgd(0.1),
+                bfopt.neighbor_communicator(bf.static_schedule())),
+            bfopt.win_put_optimizer(optax.sgd(0.1)),
+            bfopt.pull_get_optimizer(optax.sgd(0.1)),
+            bfopt.gradient_allreduce(optax.sgd(0.1)),
+        ):
+            params = bfopt.replicate({"w": jnp.zeros((4,))})
+            state = bfopt.init_distributed(strat, params)
+            step = bfopt.make_train_step(grad_fn, strat)
+            batch = jnp.zeros((8, 4))
+            txt = step.lower(params, state, batch).as_text(debug_info=True)
+            for name in ("COMMUNICATE", "ADAPT", "GRADIENT"):
+                assert name in txt, (strat, name)
+    finally:
+        bf.shutdown()
+
+
 def test_timeline_writer_volume(tmp_path):
     """The ring buffer + flush thread absorbs a large burst without loss."""
     out = str(tmp_path / "burst.json")

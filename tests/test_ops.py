@@ -4,6 +4,7 @@ Model: reference test/torch_ops_test.py — closed-form expected values from
 rank-valued tensors.
 """
 import numpy as np
+import jax
 import jax.numpy as jnp
 import pytest
 
@@ -267,6 +268,85 @@ def test_ragged_neighbor_allgather():
         for k, s in enumerate(nbrs):
             valid = np.asarray(g[r, k * max_d0: k * max_d0 + lengths[s]])
             np.testing.assert_array_equal(valid, np.full(valid.shape, s))
+
+
+def _count_eqns(closed_jaxpr, names):
+    """Count primitive occurrences, descending into sub-jaxprs."""
+    counts = {n: 0 for n in names}
+
+    def walk(j):
+        for eqn in j.eqns:
+            if eqn.primitive.name in counts:
+                counts[eqn.primitive.name] += 1
+            for v in eqn.params.values():
+                for e in (v if isinstance(v, (list, tuple)) else [v]):
+                    if hasattr(e, "eqns"):               # raw Jaxpr
+                        walk(e)
+                    elif hasattr(e, "jaxpr"):            # ClosedJaxpr
+                        walk(e.jaxpr)
+
+    walk(closed_jaxpr.jaxpr)
+    return counts
+
+
+def test_broadcast_is_log_tree_not_allreduce():
+    """broadcast lowers to ceil(log2 n) ppermutes and NO psum — the round-1
+    masked-psum formulation paid a full allreduce for a fan-out."""
+    import math
+    from jax.sharding import PartitionSpec as P
+    from bluefog_tpu import ops
+
+    def f(xb):
+        return ops.broadcast(xb[0], root_rank=2)[None]
+
+    jaxpr = jax.make_jaxpr(jax.shard_map(
+        f, mesh=bf.mesh(), in_specs=P("rank"), out_specs=P("rank")))(
+            jnp.zeros((N, DIM)))
+    counts = _count_eqns(jaxpr, ["ppermute", "psum_invariant", "psum"])
+    assert counts["ppermute"] == math.ceil(math.log2(N))
+    assert counts["psum"] + counts["psum_invariant"] == 0, counts
+
+
+def test_ragged_gather_is_one_collective_chain():
+    """The length channel rides in the data buffer: permute count equals the
+    schedule's round count, not 2x (round-1 paid a second full chain)."""
+    from jax.sharding import PartitionSpec as P
+    from bluefog_tpu import ops, schedule as sch
+
+    sched = sch.compile_topology(tu.RingGraph(N, connect_style=0))
+
+    def f(xb, lb):
+        data, lens = ops.ragged_neighbor_allgather(
+            xb[0], lb[0], sched, axis="rank")
+        return data[None], lens[None]
+
+    jaxpr = jax.make_jaxpr(jax.shard_map(
+        f, mesh=bf.mesh(), in_specs=(P("rank"), P("rank")),
+        out_specs=(P("rank"), P("rank"))))(
+            jnp.zeros((N, 3, 1), jnp.float32), jnp.ones((N,), jnp.int32))
+    counts = _count_eqns(jaxpr, ["ppermute"])
+    assert counts["ppermute"] == sched.num_rounds, counts
+
+
+def test_ragged_neighbor_allgather_dtypes():
+    """The byte-packed length channel round-trips every supported dtype."""
+    bf.set_topology(tu.RingGraph(N, connect_style=0))
+    max_d0 = 2
+    lengths = np.array([r % max_d0 + 1 for r in range(N)])
+    for dtype in (jnp.bfloat16, jnp.int8, jnp.bool_, jnp.complex64,
+                  jnp.int32):
+        x = np.zeros((N, max_d0, 3), np.float64)
+        for r in range(N):
+            x[r, :lengths[r]] = r + 0.5
+        xj = jnp.asarray(x).astype(dtype)
+        g, glens = bf.ragged_neighbor_allgather(xj, lengths)
+        assert g.dtype == xj.dtype
+        nbrs = tu.GetInNeighbors(tu.RingGraph(N, connect_style=0), 0)
+        np.testing.assert_array_equal(np.asarray(glens[0]), lengths[nbrs])
+        for k, s in enumerate(nbrs):
+            valid = np.asarray(g[0, k * max_d0: k * max_d0 + lengths[s]])
+            np.testing.assert_array_equal(
+                valid, np.full(valid.shape, np.asarray(xj[s, 0, 0])))
 
 
 def test_context_dynamic_topology():

@@ -679,24 +679,15 @@ def make_train_step(
     mesh = ctx.mesh if strategy.axes == ("rank",) else ctx.mesh_2d
     spec = P("rank") if strategy.axes == ("rank",) else P(("machine", "local"))
 
+    def grad3(p, ns, b):
+        loss, grads = grad_fn(p, b)
+        return loss, grads, ns
+
+    inner = _stateful_per_rank(grad3, strategy, steps_per_call, lambda ns: ns)
+
     def per_rank(params, state, batch):
-        params, state, batch = jax.tree.map(lambda x: x[0], (params, state, batch))
-        if steps_per_call == 1:
-            with jax.named_scope("GRADIENT"):
-                loss, grads = grad_fn(params, batch)
-            new_params, new_state = strategy.update(grads, state, params)
-            return jax.tree.map(lambda x: x[None], (new_params, new_state, loss))
-
-        def body(carry, b):
-            p, s = carry
-            with jax.named_scope("GRADIENT"):
-                loss, grads = grad_fn(p, b)
-            p, s = strategy.update(grads, s, p)
-            return (p, s), loss
-
-        (params, state), losses = lax.scan(
-            body, (params, state), batch, length=steps_per_call)
-        return jax.tree.map(lambda x: x[None], (params, state, losses))
+        new_params, _, new_state, losses = inner(params, {}, state, batch)
+        return new_params, new_state, losses
 
     # donate params/state: the update is functional but the caller always
     # rebinds both, so XLA can reuse their buffers in place (halves peak
@@ -705,3 +696,96 @@ def make_train_step(
         jax.shard_map(per_rank, mesh=mesh, in_specs=(spec, spec, spec),
                       out_specs=(spec, spec, spec)),
         donate_argnums=(0, 1))
+
+
+def _stateful_per_rank(grad_fn, strategy, steps_per_call, sync):
+    """Shared per-rank step body: slice off the rank axis, scan
+    (grad -> state sync -> strategy update), re-stack.  ``grad_fn(p, ns, b)
+    -> (loss, grads, new_ns)``; ``sync`` post-processes the net state."""
+    def per_rank(params, net_state, dstate, batch):
+        params, net_state, dstate, batch = jax.tree.map(
+            lambda x: x[0], (params, net_state, dstate, batch))
+
+        def one(p, ns, s, b):
+            with jax.named_scope("GRADIENT"):
+                loss, grads, ns = grad_fn(p, ns, b)
+            ns = sync(ns)
+            p, s = strategy.update(grads, s, p)
+            return p, ns, s, loss
+
+        if steps_per_call == 1:
+            out = one(params, net_state, dstate, batch)
+            return jax.tree.map(lambda x: x[None], out)
+
+        def body(carry, b):
+            p, ns, s = carry
+            p, ns, s, loss = one(p, ns, s, b)
+            return (p, ns, s), loss
+
+        (params, net_state, dstate), losses = lax.scan(
+            body, (params, net_state, dstate), batch, length=steps_per_call)
+        return jax.tree.map(
+            lambda x: x[None], (params, net_state, dstate, losses))
+
+    return per_rank
+
+
+def make_stateful_train_step(
+    grad_fn: Callable[[Any, Any, Any], Tuple[jax.Array, Any, Any]],
+    strategy: DecentralizedOptimizer,
+    *,
+    steps_per_call: int = 1,
+    state_sync: Optional[str] = None,
+    state_sync_schedule: Optional[CommSchedule] = None,
+):
+    """:func:`make_train_step` for networks with non-parameter state (BN
+    running stats, EMA shadows — haiku's ``transform_with_state``, flax's
+    ``batch_stats`` collection).
+
+    ``grad_fn(params, net_state, batch) -> (loss, grads, new_net_state)``.
+    The returned step maps ``(params, net_state, dstate, batch) ->
+    (new_params, new_net_state, new_dstate, loss)``.
+
+    ``state_sync`` keeps the per-rank state from drifting apart the way the
+    reference's per-rank BN buffers do (its broadcast only syncs at restart):
+    ``None`` leaves state rank-local (reference behavior), ``"neighbor"``
+    gossips it over the topology each step (``state_sync_schedule``
+    overrides the context schedule), ``"allreduce"`` globally averages it.
+    Integer leaves (counters) are never averaged.  Syncing requires a
+    rank-axis strategy (1-D mesh).
+    """
+    ctx = _mesh.get_context()
+    mesh = ctx.mesh if strategy.axes == ("rank",) else ctx.mesh_2d
+    spec = P("rank") if strategy.axes == ("rank",) else P(("machine", "local"))
+
+    if state_sync not in (None, "neighbor", "allreduce"):
+        raise ValueError(f"unknown state_sync {state_sync!r}")
+    if state_sync_schedule is not None and state_sync != "neighbor":
+        raise ValueError(
+            "state_sync_schedule only applies to state_sync='neighbor'")
+    if state_sync is not None and strategy.axes != ("rank",):
+        raise ValueError(
+            "state_sync requires a rank-axis strategy; sync net state "
+            "manually for hierarchical (2-D mesh) strategies")
+
+    def sync(ns):
+        if state_sync is None:
+            return ns
+        s = (state_sync_schedule if state_sync_schedule is not None
+             else _mesh.static_schedule())
+
+        def leaf(x):
+            if not jnp.issubdtype(x.dtype, jnp.floating):
+                return x
+            if state_sync == "neighbor":
+                return ops.neighbor_allreduce(x, s, axis="rank")
+            return lax.pmean(x, "rank")
+
+        with jax.named_scope("STATE_SYNC"):
+            return jax.tree.map(leaf, ns)
+
+    inner = _stateful_per_rank(grad_fn, strategy, steps_per_call, sync)
+    return jax.jit(
+        jax.shard_map(inner, mesh=mesh, in_specs=(spec,) * 4,
+                      out_specs=(spec,) * 4),
+        donate_argnums=(0, 1, 2))

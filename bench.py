@@ -55,29 +55,28 @@ def main():
     import os
     from bluefog_tpu.utils.config import RECOMMENDED_TPU_XLA_FLAGS
 
-    # Probe the accelerator twice CONCURRENTLY — once with the overlap flags
-    # (a real TPU jaxlib accepts them; a CPU-only or tunnel-client jaxlib
-    # fatally aborts on unknown --xla_tpu_* flags) and once without.  When
-    # the tunnel is down both hang, so concurrency keeps the worst case to
-    # one timeout instead of two.
-    tuned_flags = (RECOMMENDED_TPU_XLA_FLAGS + " "
-                   + os.environ.get("XLA_FLAGS", "")).strip()
-    tuned_env = dict(os.environ, XLA_FLAGS=tuned_flags)
-    p_tuned, p_plain = _start_probe(tuned_env), _start_probe(dict(os.environ))
-    deadline = time.monotonic() + 240.0
-    while time.monotonic() < deadline and (
-            p_tuned.poll() is None or p_plain.poll() is None):
-        if p_tuned.poll() == 0 or p_plain.poll() == 0:
-            break
-        time.sleep(1.0)
-    for p in (p_tuned, p_plain):
+    # Probe the accelerator SEQUENTIALLY — plain first, then with the
+    # overlap flags (a real TPU jaxlib accepts them; a CPU-only or
+    # tunnel-client jaxlib fatally aborts on unknown --xla_tpu_* flags).
+    # Never dial the tunnel from two processes at once: the single-client
+    # axon relay wedges under concurrent connections and stays wedged for
+    # every later dial, turning a reachable TPU into a CPU-fallback run.
+    def _probe(env, timeout_s):
+        p = _start_probe(env)
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline and p.poll() is None:
+            time.sleep(1.0)
         if p.poll() is None:
             p.kill()
-    if p_tuned.returncode == 0:
-        on_accelerator = True
+            p.wait()
+        return p.returncode == 0
+
+    tuned_flags = (RECOMMENDED_TPU_XLA_FLAGS + " "
+                   + os.environ.get("XLA_FLAGS", "")).strip()
+    on_accelerator = _probe(dict(os.environ), 240.0)
+    if on_accelerator and _probe(
+            dict(os.environ, XLA_FLAGS=tuned_flags), 180.0):
         os.environ["XLA_FLAGS"] = tuned_flags
-    else:
-        on_accelerator = p_plain.returncode == 0
     if not on_accelerator:
         print("bench: accelerator unreachable, falling back to CPU "
               "(tiny shapes; the number is NOT the TPU headline)",

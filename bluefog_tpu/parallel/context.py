@@ -15,6 +15,7 @@ of reading an ambient "my rank" (reference: ``basics.py:200-265``).
 """
 from __future__ import annotations
 
+import os
 import threading
 from dataclasses import dataclass
 from typing import Callable, List, Optional
@@ -106,6 +107,17 @@ def init(
             from jax._src import xla_bridge as _xb
             if not _xb.backends_are_initialized():
                 jax.config.update("jax_platforms", platform)
+                # An explicit platform still joins the process group when
+                # launched by bfrun-tpu: pin the backend FIRST, then
+                # bootstrap — otherwise every worker reports process_index 0
+                # and multi-process sessions deadlock.  Only the explicit
+                # BLUEFOG_COORDINATOR bootstrap, NOT pod auto-detect:
+                # bf.init(platform="cpu") on one pod host is a local debug
+                # session, and a no-arg jax.distributed.initialize() there
+                # would block waiting for the other hosts.
+                if os.environ.get("BLUEFOG_COORDINATOR"):
+                    from ..run.launcher import maybe_initialize_distributed
+                    maybe_initialize_distributed()
             devices = jax.devices(platform)
         else:
             # multi-host bootstrap when launched by bfrun-tpu or on a TPU pod

@@ -15,9 +15,11 @@ environment.  This launcher keeps the familiar CLI surface:
 Env forwarding matches bfrun's ``-x``/env behavior: the child inherits the
 environment plus BLUEFOG_* variables are always passed through.
 
-The reference's interactive mode (``ibfrun``, ipyparallel) has no TPU
-counterpart here; for interactive work use a colab-style single-host session
-— the SPMD model makes every rank visible in one process.
+Interactive mode (reference: ``ibfrun``): ``--interactive`` alone opens a
+single-process REPL (SPMD makes every rank visible in one process);
+``--interactive -np N`` drives N spawned SPMD workers from a local REPL; on
+real multi-host clusters run ``--interactive-worker`` on each host and
+``--interactive --num-processes N`` on the driver (see ``interactive.py``).
 """
 from __future__ import annotations
 
@@ -51,9 +53,21 @@ def build_parser() -> argparse.ArgumentParser:
                    help="do not add the recommended TPU overlap XLA flags")
     p.add_argument("--interactive", action="store_true",
                    help="drop into an initialized Python REPL instead of "
-                        "running a command (reference: ibfrun — under SPMD "
-                        "one session sees every rank, so no ipyparallel "
-                        "cluster is needed)")
+                        "running a command (reference: ibfrun). With -np N "
+                        "the REPL drives N spawned SPMD workers; with "
+                        "--num-processes it waits for remote "
+                        "--interactive-worker hosts; alone it is a "
+                        "single-process session")
+    p.add_argument("--interactive-worker", action="store_true",
+                   help="run this host as an interactive worker that "
+                        "executes cells from a remote --interactive "
+                        "controller (reference: ibfrun's ipengine)")
+    p.add_argument("--controller", default=None,
+                   help="controller address host:port "
+                        "(with --interactive-worker)")
+    p.add_argument("--listen-port", type=int, default=0,
+                   help="port the interactive controller listens on "
+                        "(default: ephemeral, printed at start)")
     p.add_argument("command", nargs=argparse.REMAINDER,
                    help="the training command, e.g. python train.py")
     return p
@@ -79,8 +93,87 @@ def _child_env(args) -> dict:
     return env
 
 
+def _interactive_cluster(args, env) -> int:
+    """Multi-host interactive session (the ibfrun counterpart): drive N SPMD
+    workers from a local REPL.  ``-np N`` spawns the workers here (local
+    emulation, like `ibfrun -np`); ``--num-processes N`` without -np waits
+    for N remote ``--interactive-worker`` hosts to dial in."""
+    from .interactive import Controller, repl
+
+    n = args.num_local_processes or args.num_processes
+    # local spawn never exposes the unauthenticated cell socket beyond
+    # loopback; remote-worker mode must listen on all interfaces
+    host = "127.0.0.1" if args.num_local_processes else "0.0.0.0"
+    ctrl = Controller(n, port=args.listen_port, host=host)
+    print(f"interactive controller listening on port {ctrl.port} "
+          f"({n} worker(s))", flush=True)
+    procs = []
+    if args.num_local_processes:
+        procs = _spawn_local_workers(
+            n, args.coordinator or "127.0.0.1:48293", env,
+            [sys.executable, "-m", "bluefog_tpu.run.interactive",
+             "--connect", f"127.0.0.1:{ctrl.port}"])
+    try:
+        ranks = ctrl.wait_for_workers()
+        print(f"workers ready: ranks {ranks}", flush=True)
+        repl(ctrl)
+    finally:
+        ctrl.shutdown()
+        for p in procs:
+            try:
+                p.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                p.kill()
+    return 0
+
+
+def _spawn_local_workers(n, coordinator, env, cmd):
+    """Spawn N local processes wired into one jax.distributed group (the
+    `mpirun -np N` stand-in shared by the batch and interactive paths)."""
+    procs = []
+    for pid in range(n):
+        penv = dict(env)
+        penv.update({
+            "JAX_PLATFORMS": env.get("JAX_PLATFORMS", "cpu"),
+            "BLUEFOG_COORDINATOR": coordinator,
+            "BLUEFOG_NUM_PROCESSES": str(n),
+            "BLUEFOG_PROCESS_ID": str(pid),
+        })
+        procs.append(subprocess.Popen(cmd, env=penv))
+    return procs
+
+
+def _apply_coordinator_env(args, env) -> None:
+    """Map --coordinator/--num-processes/--process-id into the BLUEFOG_*
+    bootstrap env ``bf.init`` reads (shared by batch and worker modes)."""
+    if (args.num_processes or 1) > 1 and args.process_id is None:
+        raise SystemExit(
+            "--process-id is required with --coordinator off-pod: "
+            "defaulting every host to process 0 would deadlock the "
+            "coordinator barrier")
+    env.update({
+        "BLUEFOG_COORDINATOR": args.coordinator,
+        "BLUEFOG_NUM_PROCESSES": str(args.num_processes or 1),
+    })
+    if args.process_id is not None:
+        env["BLUEFOG_PROCESS_ID"] = str(args.process_id)
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
+    if args.interactive_worker:
+        if not args.controller:
+            raise SystemExit("--interactive-worker requires --controller")
+        env = _child_env(args)
+        # the worker joins the SPMD process group exactly like a batch rank:
+        # forward any --coordinator bootstrap into its env
+        if args.coordinator:
+            _apply_coordinator_env(args, env)
+        return subprocess.call(
+            [sys.executable, "-m", "bluefog_tpu.run.interactive",
+             "--connect", args.controller], env=env)
+    if args.interactive and (args.num_local_processes or args.num_processes):
+        return _interactive_cluster(args, _child_env(args))
     if args.interactive:
         env = _child_env(args)
         # honor JAX_PLATFORMS even under plugins that force jax_platforms at
@@ -105,33 +198,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         # local multi-process emulation: each process sees a slice of a
         # virtual CPU device mesh via jax.distributed (testing path; plays
         # the role of `mpirun -np N` on one machine)
-        n = args.num_local_processes
-        coordinator = args.coordinator or "127.0.0.1:48291"
-        procs = []
-        for pid in range(n):
-            penv = dict(env)
-            penv.update({
-                "JAX_PLATFORMS": env.get("JAX_PLATFORMS", "cpu"),
-                "BLUEFOG_COORDINATOR": coordinator,
-                "BLUEFOG_NUM_PROCESSES": str(n),
-                "BLUEFOG_PROCESS_ID": str(pid),
-            })
-            procs.append(subprocess.Popen(cmd, env=penv))
+        procs = _spawn_local_workers(
+            args.num_local_processes,
+            args.coordinator or "127.0.0.1:48291", env, cmd)
         codes = [p.wait() for p in procs]   # wait on ALL before deciding
         return next((c for c in codes if c), 0)
 
     if args.coordinator:
-        if (args.num_processes or 1) > 1 and args.process_id is None:
-            raise SystemExit(
-                "--process-id is required with --coordinator off-pod: "
-                "defaulting every host to process 0 would deadlock the "
-                "coordinator barrier")
-        env.update({
-            "BLUEFOG_COORDINATOR": args.coordinator,
-            "BLUEFOG_NUM_PROCESSES": str(args.num_processes or 1),
-        })
-        if args.process_id is not None:
-            env["BLUEFOG_PROCESS_ID"] = str(args.process_id)
+        _apply_coordinator_env(args, env)
 
     return subprocess.call(cmd, env=env)
 

@@ -73,7 +73,18 @@ def main():
 
     tuned_flags = (RECOMMENDED_TPU_XLA_FLAGS + " "
                    + os.environ.get("XLA_FLAGS", "")).strip()
-    on_accelerator = _probe(dict(os.environ), 240.0)
+    # the tunnel wedges transiently (a killed client can jam the relay for
+    # a while) — retry the plain probe a few times before giving up on the
+    # accelerator for the whole benchmark
+    on_accelerator = False
+    for attempt in range(3):
+        if _probe(dict(os.environ), 240.0):
+            on_accelerator = True
+            break
+        print(f"bench: accelerator probe attempt {attempt + 1}/3 failed",
+              file=sys.stderr)
+        if attempt < 2:
+            time.sleep(45.0)
     if on_accelerator and _probe(
             dict(os.environ, XLA_FLAGS=tuned_flags), 180.0):
         os.environ["XLA_FLAGS"] = tuned_flags

@@ -8,6 +8,8 @@ are implemented in C++ as well:
   (reference: ``common/timeline.{h,cc}``'s spsc queue + TimelineWriter).
 * ``schedule.cc`` — edge -> ppermute-round coloring for large topologies
   (reference: graph-communicator construction, ``mpi_context.cc:412-430``).
+* ``loader.cc`` — multi-threaded batch row-gather for the input pipeline
+  (reference: the role of torch DataLoader worker processes).
 
 The shared library is built on demand with ``g++`` (no pip/pybind needed —
 plain ``extern "C"`` + ctypes) and cached next to the sources.  Every entry
@@ -23,7 +25,7 @@ from typing import List, Optional, Sequence, Tuple
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _LIB_PATH = os.path.join(_HERE, "libbft_native.so")
-_SOURCES = ("timeline.cc", "schedule.cc")
+_SOURCES = ("timeline.cc", "schedule.cc", "loader.cc")
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
@@ -78,6 +80,11 @@ def load() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
             ctypes.c_int64, ctypes.c_int32, ctypes.POINTER(ctypes.c_int32)]
         lib.bft_color_edges.restype = ctypes.c_int32
+        lib.bft_gather_rows.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int32]
+        lib.bft_gather_rows.restype = ctypes.c_int32
         _lib = lib
         return _lib
 
@@ -120,6 +127,45 @@ def color_edges_native(
     for i in order:
         rounds[int(out[i])].append(dedup[i])
     return rounds
+
+
+# ---------------------------------------------------------------------------
+# loader: native multi-threaded row gather
+# ---------------------------------------------------------------------------
+
+def gather_rows_native(src, idx, threads: int = 4):
+    """``src[idx]`` for row indices via the native thread-pool memcpy engine.
+
+    Returns None when the library is unavailable or the layout is not a
+    plain C-contiguous row gather (callers fall back to numpy).
+    """
+    lib = load()
+    if lib is None:
+        return None
+    import numpy as np
+
+    src = np.asarray(src)
+    # raw-memcpy engine: refuse layouts it cannot handle rather than pay a
+    # hidden whole-array copy (non-contiguous) or corrupt refcounts (object
+    # dtype) — callers fall back to numpy
+    if src.dtype.hasobject or not src.flags.c_contiguous or src.ndim < 1:
+        return None
+    flat_idx = np.ascontiguousarray(idx, dtype=np.int64).reshape(-1)
+    # numpy row-gather semantics: negative indices wrap
+    flat_idx = np.where(flat_idx < 0, flat_idx + src.shape[0], flat_idx)
+    row_bytes = src.dtype.itemsize * int(np.prod(src.shape[1:], dtype=np.int64))
+    if row_bytes <= 0:
+        return None
+    dst = np.empty((flat_idx.size,) + src.shape[1:], dtype=src.dtype)
+    rc = lib.bft_gather_rows(
+        dst.ctypes.data_as(ctypes.c_char_p),
+        src.ctypes.data_as(ctypes.c_char_p),
+        row_bytes,
+        flat_idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        flat_idx.size, src.shape[0], int(threads))
+    if rc != 0:
+        raise IndexError("gather index out of range")
+    return dst.reshape(tuple(np.shape(idx)) + src.shape[1:])
 
 
 # ---------------------------------------------------------------------------

@@ -9,10 +9,17 @@ reference's loader worker processes play.
 
 Works with any indexable source of numpy arrays (arrays, memmaps, or a
 callable producing per-index samples).
+
+The batch-assembly hot loop (index-gathering rows into a staging buffer)
+runs through the native thread-pool engine (``_native/loader.cc``) when the
+toolchain is available — the role the reference's DataLoader worker
+processes play — and a host worker thread produces batch t+1 while batch t
+trains, so gather, transfer, and compute all overlap.
 """
 from __future__ import annotations
 
 import collections
+import queue as _queue
 import threading
 from typing import Any, Callable, Iterator, Optional, Sequence, Tuple
 
@@ -20,6 +27,7 @@ import jax
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from . import _native
 from .parallel import context as _mesh
 
 __all__ = ["ShardedLoader", "prefetch_to_device"]
@@ -44,6 +52,8 @@ class ShardedLoader:
         seed: int = 0,
         drop_remainder: bool = True,
         prefetch: int = 2,
+        host_workers: int = 1,
+        native: Optional[bool] = None,
     ):
         if not arrays:
             raise ValueError("need at least one array")
@@ -55,6 +65,12 @@ class ShardedLoader:
         self.shuffle = shuffle
         self.seed = seed
         self.prefetch = prefetch
+        # host_workers: 0 assembles batches inline; 1 (default) runs the
+        # gather loop in a producer thread so host batching overlaps device
+        # compute (the reference's num_workers analog — one suffices since
+        # the native gather is itself multi-threaded)
+        self.host_workers = host_workers
+        self.native = _native.available() if native is None else native
         if not drop_remainder:
             raise NotImplementedError(
                 "static shapes require drop_remainder=True on TPU")
@@ -107,13 +123,72 @@ class ShardedLoader:
                           r * per_rank + (s + 1) * self.batch_size]
                     for r in range(n)
                 ])
-                batch.append(a[idx])
+                batch.append(self._gather(a, idx))
             yield tuple(batch)
+
+    def _gather(self, a: np.ndarray, idx: np.ndarray) -> np.ndarray:
+        if self.native:
+            out = _native.gather_rows_native(a, idx)
+            if out is not None:
+                return out
+        return a[idx]
 
     def __iter__(self) -> Iterator[Tuple[jax.Array, ...]]:
         sharding = NamedSharding(_mesh.get_context().mesh, P("rank"))
-        yield from prefetch_to_device(
-            self._host_batches(), sharding, size=self.prefetch)
+        host = self._host_batches()
+        if self.host_workers > 0:
+            host = _background(host, size=self.prefetch)
+        yield from prefetch_to_device(host, sharding, size=self.prefetch)
+
+
+def _background(iterator: Iterator[Any], *, size: int = 2) -> Iterator[Any]:
+    """Run ``iterator`` in a producer thread with a bounded queue.
+
+    The ctypes gather and ``np.stack`` release the GIL for their copies, so
+    one producer thread genuinely overlaps batch assembly with the consumer's
+    device work.  Exceptions re-raise at the consumer."""
+    q: _queue.Queue = _queue.Queue(maxsize=max(1, size))
+    end = object()
+    stop = threading.Event()
+    failure: list = []
+
+    def run():
+        try:
+            for item in iterator:
+                # bounded put that notices consumer abandonment — otherwise
+                # an early `break` in the training loop leaks this thread
+                # blocked in put() plus every batch it holds
+                while not stop.is_set():
+                    try:
+                        q.put(item, timeout=0.2)
+                        break
+                    except _queue.Full:
+                        continue
+                if stop.is_set():
+                    return
+        except BaseException as exc:   # noqa: BLE001 — re-raised below
+            failure.append(exc)
+        finally:
+            # the sentinel must not be dropped while a live consumer could
+            # block on q.get() forever — same stop-aware bounded put
+            while not stop.is_set():
+                try:
+                    q.put(end, timeout=0.2)
+                    break
+                except _queue.Full:
+                    continue
+
+    threading.Thread(target=run, daemon=True).start()
+    try:
+        while True:
+            item = q.get()
+            if item is end:
+                if failure:
+                    raise failure[0]
+                return
+            yield item
+    finally:
+        stop.set()
 
 
 def prefetch_to_device(

@@ -71,3 +71,99 @@ def test_epoch_arrays_shape_and_coverage():
     assert xb.shape == (N, steps, 4) and yb.shape == (N, steps, 4)
     np.testing.assert_allclose(np.asarray(yb), np.asarray(xb) * 2)
     assert sorted(np.asarray(xb).ravel().tolist()) == x.tolist()
+
+
+def test_native_gather_matches_numpy():
+    from bluefog_tpu import _native
+
+    if not _native.available():
+        pytest.skip("native toolchain unavailable")
+    rng = np.random.default_rng(0)
+    for dtype in (np.float32, np.uint8, np.int64, np.float16):
+        src = rng.normal(size=(64, 3, 5)).astype(dtype)
+        idx = rng.integers(0, 64, size=(4, 7))
+        got = _native.gather_rows_native(src, idx)
+        np.testing.assert_array_equal(got, src[idx])
+    # large path (threads engaged): > 4 MB total
+    src = rng.normal(size=(512, 64, 64)).astype(np.float32)
+    idx = rng.integers(0, 512, size=(600,))
+    np.testing.assert_array_equal(
+        _native.gather_rows_native(src, idx, threads=8), src[idx])
+    with pytest.raises(IndexError):
+        _native.gather_rows_native(src, np.array([512]))
+
+
+def test_loader_native_and_python_paths_agree():
+    x = np.arange(N * 6 * 4, dtype=np.float32).reshape(N * 6, 4)
+    y = np.arange(N * 6, dtype=np.int32)
+    a = ShardedLoader([x, y], batch_size=3, seed=7, native=True)
+    b = ShardedLoader([x, y], batch_size=3, seed=7, native=False)
+    for (xa, ya), (xb, yb) in zip(a._host_batches(), b._host_batches()):
+        np.testing.assert_array_equal(xa, xb)
+        np.testing.assert_array_equal(ya, yb)
+
+
+def test_background_producer_matches_inline():
+    x = np.random.default_rng(1).normal(size=(N * 8, 3)).astype(np.float32)
+    inline = ShardedLoader([x], batch_size=2, seed=3, host_workers=0)
+    threaded = ShardedLoader([x], batch_size=2, seed=3, host_workers=1)
+    got_i = [np.asarray(b[0]) for b in inline]
+    got_t = [np.asarray(b[0]) for b in threaded]
+    assert len(got_i) == len(got_t) == inline.steps_per_epoch()
+    for bi, bt in zip(got_i, got_t):
+        np.testing.assert_array_equal(bi, bt)
+
+
+def test_background_producer_propagates_errors():
+    from bluefog_tpu.data import _background
+
+    def boom():
+        yield 1
+        raise RuntimeError("producer failed")
+
+    it = _background(boom(), size=2)
+    assert next(it) == 1
+    with pytest.raises(RuntimeError, match="producer failed"):
+        list(it)
+
+
+def test_native_gather_refuses_unsafe_layouts():
+    from bluefog_tpu import _native
+
+    if not _native.available():
+        pytest.skip("native toolchain unavailable")
+    assert _native.gather_rows_native(
+        np.array([{"a": 1}, {"b": 2}], dtype=object), [0, 1]) is None
+    big = np.arange(24, dtype=np.float32).reshape(4, 6)
+    assert _native.gather_rows_native(big.T, [0]) is None   # non-contiguous
+    # negative indices wrap like numpy
+    np.testing.assert_array_equal(
+        _native.gather_rows_native(big, np.array([-1, 0])), big[[-1, 0]])
+
+
+def test_background_producer_stops_after_consumer_break():
+    import threading
+    import time
+
+    started = threading.Event()
+    produced = []
+
+    def slow_source():
+        started.set()
+        for i in range(1000):
+            produced.append(i)
+            yield np.zeros((2, 2)) + i
+
+    from bluefog_tpu.data import _background
+
+    it = _background(slow_source(), size=1)
+    next(it), next(it)
+    it.close()          # consumer abandons (the `break` path)
+    started.wait(5)
+    n_after_close = None
+    for _ in range(50):         # producer should park within ~a second
+        time.sleep(0.05)
+        if n_after_close == len(produced):
+            break
+        n_after_close = len(produced)
+    assert len(produced) < 20   # not drained to 1000: thread actually stopped

@@ -150,6 +150,11 @@ def gather_rows_native(src, idx, threads: int = 4):
     # dtype) — callers fall back to numpy
     if src.dtype.hasobject or not src.flags.c_contiguous or src.ndim < 1:
         return None
+    idx = np.asarray(idx)
+    # bool masks and float indices mean something different (or error) under
+    # numpy — only integer row gathers belong to this engine
+    if idx.dtype == np.bool_ or not np.issubdtype(idx.dtype, np.integer):
+        return None
     flat_idx = np.ascontiguousarray(idx, dtype=np.int64).reshape(-1)
     # numpy row-gather semantics: negative indices wrap
     flat_idx = np.where(flat_idx < 0, flat_idx + src.shape[0], flat_idx)

@@ -116,15 +116,12 @@ class ShardedLoader:
         self._epoch += 1
         per_rank = self.num_samples // n
         for s in range(steps):
-            batch = []
-            for a in self.arrays:
-                idx = np.stack([
-                    order[r * per_rank + s * self.batch_size:
-                          r * per_rank + (s + 1) * self.batch_size]
-                    for r in range(n)
-                ])
-                batch.append(self._gather(a, idx))
-            yield tuple(batch)
+            idx = np.stack([
+                order[r * per_rank + s * self.batch_size:
+                      r * per_rank + (s + 1) * self.batch_size]
+                for r in range(n)
+            ])
+            yield tuple(self._gather(a, idx) for a in self.arrays)
 
     def _gather(self, a: np.ndarray, idx: np.ndarray) -> np.ndarray:
         if self.native:

@@ -167,3 +167,13 @@ def test_background_producer_stops_after_consumer_break():
             break
         n_after_close = len(produced)
     assert len(produced) < 20   # not drained to 1000: thread actually stopped
+
+
+def test_native_gather_refuses_non_integer_indices():
+    from bluefog_tpu import _native
+
+    if not _native.available():
+        pytest.skip("native toolchain unavailable")
+    src = np.arange(12, dtype=np.float32).reshape(4, 3)
+    assert _native.gather_rows_native(src, np.array([True, False])) is None
+    assert _native.gather_rows_native(src, np.array([0.5, 1.5])) is None

@@ -35,6 +35,16 @@ def tpu_mesh():
     return Mesh(np.array(td.devices), ("rank",))
 
 
+@pytest.fixture(scope="module")
+def tpu_mesh_2d():
+    from jax.experimental import topologies
+    try:
+        td = topologies.get_topology_desc("v5e:2x4", platform="tpu")
+    except Exception as e:          # no libtpu in this environment
+        pytest.skip(f"TPU AOT topology unavailable: {e}")
+    return Mesh(np.array(td.devices).reshape(2, 4), ("machine", "local"))
+
+
 def _sharded_sds(tree, mesh):
     return jax.tree.map(
         lambda x: jax.ShapeDtypeStruct(
@@ -143,3 +153,106 @@ def test_pallas_flash_kernels_lower_for_tpu(tpu_mesh):
     assert txt.count("tpu_custom_call") == 2
     # the ring rotation is ppermute (async on TPU), present in both passes
     assert len(_op_lines(txt, "collective-permute-start")) >= 2
+
+
+def test_dynamic_one_peer_is_one_permute_per_step(tpu_mesh):
+    """Dynamic one-peer gossip compiles to exactly ONE async permute per
+    scanned step — communication constant in n (the table in
+    docs/PERFORMANCE.md), with the per-step branch select never falling back
+    to a gather/allreduce."""
+    scheds = sch.compile_dynamic_schedules(
+        lambda r: tu.GetDynamicOnePeerSendRecvRanks(
+            tu.ExponentialTwoGraph(N), r), N)
+    strat = bfopt.adapt_with_combine(
+        optax.sgd(0.01), bfopt.neighbor_communicator(schedules=scheds))
+    steps = len(scheds)
+
+    def per_rank(params, state, batch):
+        params, state, batch = jax.tree.map(
+            lambda t: t[0], (params, state, batch))
+        def body(carry, b):
+            p, s = carry
+            loss, grads = jax.value_and_grad(
+                lambda q: jnp.mean((b @ q["w"]).astype(jnp.float32) ** 2))(p)
+            p, s = strat.update(grads, s, p)
+            return (p, s), loss
+        (params, state), losses = jax.lax.scan(
+            body, (params, state), batch, length=steps)
+        return jax.tree.map(lambda t: t[None], (params, state, losses))
+
+    fn = jax.jit(jax.shard_map(
+        per_rank, mesh=tpu_mesh, in_specs=(P("rank"),) * 3,
+        out_specs=(P("rank"),) * 3))
+    params = {"w": jnp.zeros((N, 128, 128), jnp.bfloat16)}
+    state0 = strat.init(jax.tree.map(lambda x: x[0], params))
+    state = jax.tree.map(lambda x: jnp.broadcast_to(x, (N,) + x.shape), state0)
+    batch = jnp.zeros((N, steps, 16, 128), jnp.bfloat16)
+    sds = _sharded_sds((params, state, batch), tpu_mesh)
+    txt = fn.lower(*sds).compile().as_text()
+
+    starts = _op_lines(txt, "collective-permute-start")
+    # every dynamic step is a single permutation of the rank axis: the
+    # scan body holds one async permute per branch (or one shared permute
+    # with branch-selected source-target pairs), never more than one per
+    # step of the period — and no branch degrades to all-gather/all-reduce
+    assert 1 <= len(starts) <= steps, txt.count("collective-permute")
+    # substring check catches the async -start forms too
+    assert txt.count("all-gather") == 0
+    assert txt.count("all-reduce") == 0
+
+
+def test_hierarchical_lowering_splits_axes(tpu_mesh_2d):
+    """hierarchical_neighbor_allreduce on the 2-D (machine x local) mesh:
+    the intra-machine average lowers to an all-reduce whose replica groups
+    stay within each machine's local axis, and the machine-level gossip is
+    async permutes — psum rides ICI, gossip rides the cross-machine axis
+    (reference: mpi_controller.cc:452-507 three-phase hierarchy)."""
+    from bluefog_tpu.ops import collectives as C
+
+    msched = sch.compile_topology(tu.RingGraph(2))
+
+    def per_rank(x):
+        x = x[0, 0]
+        out = C.hierarchical_neighbor_allreduce(x, msched)
+        return out[None, None]
+
+    fn = jax.jit(jax.shard_map(
+        per_rank, mesh=tpu_mesh_2d,
+        in_specs=(P("machine", "local"),), out_specs=P("machine", "local")))
+    x = jax.ShapeDtypeStruct(
+        (2, 4, 256, 256), jnp.bfloat16,
+        sharding=NamedSharding(tpu_mesh_2d, P("machine", "local")))
+    txt = fn.lower(x).compile().as_text()
+
+    ars = [l for l in txt.splitlines()
+           if re.search(r"= \S+ all-reduce(-start)?\(", l)]
+    assert ars, "intra-machine pmean must lower to an all-reduce"
+    # replica groups of the local pmean partition within machines:
+    # {0,1,2,3} and {4,5,6,7}, never mixing the two machines
+    groups = re.findall(r"replica_groups=\{(.*?)\}", " ".join(ars))
+    assert groups
+    for g in groups:
+        for grp in re.findall(r"\{([\d,]+)\}", "{" + g + "}"):
+            members = sorted(int(v) for v in grp.split(","))
+            assert members in ([0, 1, 2, 3], [4, 5, 6, 7]), ars
+    assert _op_lines(txt, "collective-permute-start"), \
+        "machine-level gossip must stay an async permute"
+
+
+def test_broadcast_is_log_tree_no_reduction(tpu_mesh):
+    """broadcast lowers to ceil(log2 n) async permutes and ZERO all-reduces
+    on the TPU pipeline (the binomial tree, not the masked-psum formulation)."""
+    from bluefog_tpu.ops import collectives as C
+
+    def per_rank(x):
+        return C.broadcast(x[0], 3)[None]
+
+    fn = jax.jit(jax.shard_map(
+        per_rank, mesh=tpu_mesh, in_specs=(P("rank"),),
+        out_specs=P("rank")))
+    x = jax.ShapeDtypeStruct(
+        (N, 1024, 1024), jnp.bfloat16,
+        sharding=NamedSharding(tpu_mesh, P("rank")))
+    txt = fn.lower(x).compile().as_text()
+    assert len(_op_lines(txt, "collective-permute-start")) == 3  # log2(8)
+    assert txt.count("all-reduce") == 0    # incl. async -start form

@@ -151,13 +151,15 @@ def neighbor_allreduce(
     dst_weights=None,
     schedule: Optional[CommSchedule] = None,
     step: Optional[int] = None,
+    wire: Optional[str] = None,
 ) -> jax.Array:
     """Weighted neighbor averaging of each rank's slice (the flagship op).
 
     Reference: ``bf.neighbor_allreduce`` (``mpi_ops.py:540-592``).  When a
     dynamic topology is installed (``bf.set_dynamic_topology``), pass the
     iteration counter as ``step`` and the matching schedule of the period is
-    used automatically.
+    used automatically.  ``wire`` compresses the gossiped bytes
+    (``"bf16"``/``"int8"``, see :func:`bluefog_tpu.ops.neighbor_allreduce`).
     """
     ctx = _mesh.get_context()
     _check_distributed(x, ctx.size)
@@ -171,9 +173,10 @@ def neighbor_allreduce(
         schedule = dyn[int(step) % len(dyn)]
     sched = resolve_schedule(self_weight, src_weights, dst_weights, schedule)
     fn = _cached(
-        ("nar", sched, ctx.mesh, x.shape, x.dtype.name),
+        ("nar", sched, ctx.mesh, x.shape, x.dtype.name, wire),
         lambda: _shard_map_1d(
-            _per_rank(partial(ops.neighbor_allreduce, sched=sched, axis="rank")),
+            _per_rank(partial(ops.neighbor_allreduce, sched=sched,
+                              axis="rank", wire=wire)),
             ctx.mesh))
     return fn(x)
 

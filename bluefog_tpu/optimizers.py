@@ -62,6 +62,7 @@ def neighbor_communicator(
     *,
     axis: Axis = "rank",
     fuse: bool = True,
+    wire: Optional[str] = None,
 ) -> Communicator:
     """Neighbor averaging of a params pytree; dynamic when ``schedules``.
 
@@ -69,7 +70,10 @@ def neighbor_communicator(
     (the reference instead re-negotiates per-iteration send/recv lists,
     ``optimizers.py`` + ``examples/pytorch_benchmark.py:182-208``).
     ``fuse`` gossips one flat buffer per dtype instead of one permute chain
-    per leaf (reference fusion buffers, SURVEY.md §2.4).
+    per leaf (reference fusion buffers, SURVEY.md §2.4).  ``wire`` compresses
+    the gossiped bytes on the wire (``"bf16"``/``"int8"``, see
+    :func:`bluefog_tpu.ops.neighbor_allreduce`); with ``fuse`` the int8 scale
+    is per flat buffer, amortizing the side channel across the whole model.
     """
     if (schedule is None) == (schedules is None):
         raise ValueError("pass exactly one of schedule / schedules")
@@ -79,10 +83,13 @@ def neighbor_communicator(
 
     def comm(params, step):
         def leaf(x):
+            # non-real-float leaves (int counters, complex) always travel
+            # uncompressed — quantizing them is meaningless or lossy
+            w = wire if jnp.issubdtype(x.dtype, jnp.floating) else None
             if schedule is not None:
-                return ops.neighbor_allreduce(x, schedule, axis=axis)
+                return ops.neighbor_allreduce(x, schedule, axis=axis, wire=w)
             branches = [
-                partial(ops.neighbor_allreduce, sched=s, axis=axis)
+                partial(ops.neighbor_allreduce, sched=s, axis=axis, wire=w)
                 for s in schedules
             ]
             return lax.switch(step % len(schedules), branches, x)
@@ -101,19 +108,28 @@ def hierarchical_communicator(
     machine_axis: Axis = "machine",
     local_axis: Axis = "local",
     fuse: bool = True,
+    wire: Optional[str] = None,
 ) -> Communicator:
     """Machine-level neighbor averaging on the 2-D mesh (reference:
-    ``DistributedHierarchicalNeighborAllreduceOptimizer``)."""
+    ``DistributedHierarchicalNeighborAllreduceOptimizer``).
+
+    ``wire`` compresses the machine-level gossip — exactly the edges that
+    ride DCN on a multi-slice deployment, where compression pays most; the
+    intra-machine pmean (ICI) stays full precision.
+    """
     if (machine_schedule is None) == (machine_schedules is None):
         raise ValueError("pass exactly one of machine_schedule / machine_schedules")
 
     def comm(params, step):
         def leaf(x):
+            w = wire if jnp.issubdtype(x.dtype, jnp.floating) else None
             xm = lax.pmean(x, local_axis)
             if machine_schedule is not None:
-                return ops.neighbor_allreduce(xm, machine_schedule, axis=machine_axis)
+                return ops.neighbor_allreduce(xm, machine_schedule,
+                                              axis=machine_axis, wire=w)
             branches = [
-                partial(ops.neighbor_allreduce, sched=s, axis=machine_axis)
+                partial(ops.neighbor_allreduce, sched=s, axis=machine_axis,
+                        wire=w)
                 for s in machine_schedules
             ]
             return lax.switch(step % len(machine_schedules), branches, xm)
@@ -605,6 +621,7 @@ def _comm_from_type(communication_type: str, kw):
     kw = dict(kw)
     sched = kw.pop("schedule", None)
     scheds = kw.pop("schedules", None)
+    wire = kw.pop("wire", None)
     if communication_type == "neighbor_allreduce":
         if sched is None and scheds is None:
             # an installed dynamic topology (bf.set_dynamic_topology) takes
@@ -613,17 +630,21 @@ def _comm_from_type(communication_type: str, kw):
             scheds = _mesh.get_context().dynamic_schedules
             if scheds is None:
                 sched = _mesh.static_schedule()
-        comm = neighbor_communicator(sched, scheds)
+        comm = neighbor_communicator(sched, scheds, wire=wire)
     elif communication_type == "hierarchical_neighbor_allreduce":
         if sched is None and scheds is None:
             sched = _mesh.machine_schedule()
-        comm = hierarchical_communicator(sched, scheds)
+        comm = hierarchical_communicator(sched, scheds, wire=wire)
         kw.setdefault("axes", ("machine", "local"))
     elif communication_type in ("allreduce", "empty"):
         if sched is not None or scheds is not None:
             raise TypeError(
                 f"communication_type {communication_type!r} does not take a "
                 "schedule; dynamic topologies require neighbor_allreduce")
+        if wire is not None:
+            raise TypeError(
+                f"wire compression applies to gossip, not "
+                f"communication_type {communication_type!r}")
         comm = (allreduce_communicator() if communication_type == "allreduce"
                 else empty_communicator())
     else:

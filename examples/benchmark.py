@@ -30,6 +30,9 @@ def main():
                                  "allreduce", "hierarchical_neighbor_allreduce",
                                  "win_put", "pull_get", "push_sum", "empty"])
     parser.add_argument("--atc", action="store_true")
+    parser.add_argument("--wire", default=None, choices=["bf16", "int8"],
+                        help="compress gossip bytes on the wire "
+                             "(neighbor/hierarchical strategies)")
     parser.add_argument("--dynamic-topology", action="store_true")
     parser.add_argument("--batch-size", type=int, default=32)
     parser.add_argument("--num-warmup", type=int, default=1)
@@ -148,7 +151,8 @@ def main():
         factory = (bfopt.DistributedAdaptThenCombineOptimizer if args.atc
                    else bfopt.DistributedAdaptWithCombineOptimizer)
         strategy = factory(opt, communication_type=name,
-                           **({"schedules": scheds} if scheds else {}))
+                           **({"schedules": scheds} if scheds else {}),
+                           **({"wire": args.wire} if args.wire else {}))
 
     dist_params = bfopt.replicate(state0)
     dist_state = bfopt.init_distributed(strategy, dist_params)

@@ -82,3 +82,32 @@ def test_hierarchical_weight_validation():
         bf.hierarchical_neighbor_allreduce(
             x, schedule=bf.machine_schedule(), self_weight=0.5,
             src_machine_weights=[{(m - 1) % M: 0.5} for m in range(M)])
+
+
+def test_hierarchical_communicator_int8_wire_matches_uncompressed_closely():
+    """wire= compresses only the machine-level gossip; result stays within
+    the int8 quantization bound of the uncompressed hierarchical op."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from bluefog_tpu import optimizers as bfopt
+    from bluefog_tpu import schedule as sch
+    from bluefog_tpu.parallel import context as _mesh
+
+    ctx = _mesh.get_context()
+    msched = sch.compile_topology(tu.RingGraph(M))
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(8, 16)).astype(np.float32))
+
+    def run(wire):
+        comm = bfopt.hierarchical_communicator(msched, wire=wire, fuse=False)
+        fn = jax.jit(jax.shard_map(
+            lambda p: jax.tree.map(lambda t: t[None, None],
+                                   comm(jax.tree.map(lambda t: t[0, 0], p), 0)),
+            mesh=ctx.mesh_2d,
+            in_specs=(P(("machine", "local")),),
+            out_specs=P(("machine", "local"))))
+        return np.asarray(fn(x))
+
+    exact, wired = run(None), run("int8")
+    assert np.abs(exact - wired).max() <= np.abs(x).max() / 254.0 * 4

@@ -394,3 +394,50 @@ def test_dynamic_empty_send_recv():
         np.testing.assert_allclose(
             np.asarray(out[d]), np.full(DIM, 0.5 * vals[d] + 0.5 * vals[s]),
             rtol=1e-5)
+
+
+class TestWireCompression:
+    """wire= compresses gossip bytes (reference fp16 wire: common/half.cc;
+    int8 goes beyond)."""
+
+    def test_bf16_wire_exact_on_representable_values(self):
+        bf.set_topology(tu.RingGraph(N), is_weighted=True)
+        x = rank_tensor()                       # small ints: exact in bf16
+        exact = bf.neighbor_allreduce(x)
+        wired = bf.neighbor_allreduce(x, wire="bf16")
+        np.testing.assert_allclose(np.asarray(wired), np.asarray(exact),
+                                   rtol=1e-6)
+
+    def test_int8_wire_error_bounded_by_scale(self):
+        bf.set_topology(tu.ExponentialTwoGraph(N), is_weighted=True)
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.normal(size=(N, DIM)).astype(np.float32))
+        exact = np.asarray(bf.neighbor_allreduce(x))
+        wired = np.asarray(bf.neighbor_allreduce(x, wire="int8"))
+        # each received value errs by <= scale/2 = max|x|/254; the combine's
+        # weights sum to <= 1, so the output error is <= max|x|/254 per term
+        bound = np.abs(np.asarray(x)).max() / 254.0 * 4
+        assert np.abs(wired - exact).max() <= bound
+        assert np.abs(wired - exact).max() > 0    # it did quantize
+
+    def test_int8_wire_close_on_small_integers(self):
+        bf.set_topology(tu.RingGraph(N), is_weighted=False)
+        out = bf.neighbor_allreduce(rank_tensor(), wire="int8")
+        vals = np.arange(N, dtype=np.float64)
+        topo = tu.RingGraph(N)
+        for r in range(N):
+            nbrs = tu.GetInNeighbors(topo, r)
+            expected = (vals[r] + sum(vals[s] for s in nbrs)) / (len(nbrs) + 1)
+            np.testing.assert_allclose(np.asarray(out[r]),
+                                       np.full(DIM, expected), atol=0.06)
+
+    def test_wire_rejects_integer_input(self):
+        bf.set_topology(tu.RingGraph(N), is_weighted=True)
+        x = jnp.zeros((N, DIM), jnp.int32)
+        with pytest.raises(ValueError, match="float input"):
+            bf.neighbor_allreduce(x, wire="int8")
+
+    def test_unknown_wire_rejected(self):
+        bf.set_topology(tu.RingGraph(N), is_weighted=True)
+        with pytest.raises(ValueError, match="unknown wire codec"):
+            bf.neighbor_allreduce(rank_tensor(), wire="fp4")

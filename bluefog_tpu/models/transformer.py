@@ -13,7 +13,8 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from ..ops import ring_attention
+from ..ops import ring_attention, ulysses_attention
+from ..ops.ulysses import dense_attention
 
 
 class RingTransformerBlock(nn.Module):
@@ -22,6 +23,8 @@ class RingTransformerBlock(nn.Module):
     mlp_ratio: int = 4
     axis: Optional[str] = None          # mesh axis the sequence is sharded over
     dtype: Any = jnp.bfloat16
+    sp_mode: str = "ring"               # "ring" (K/V rotation) | "ulysses"
+                                        # (head-scatter all_to_all)
     use_pallas: bool = False            # VMEM flash kernel for the attention
     pallas_interpret: Optional[bool] = None   # override backend auto-detect
 
@@ -36,19 +39,19 @@ class RingTransformerBlock(nn.Module):
         q = q.reshape(B, T, H, C // H)
         k = k.reshape(B, T, H, C // H)
         v = v.reshape(B, T, H, C // H)
+        if self.sp_mode not in ("ring", "ulysses"):
+            raise ValueError(
+                f"unknown sp_mode {self.sp_mode!r}; choose 'ring' or "
+                "'ulysses'")
         if self.axis is not None:
-            att = ring_attention(q, k, v, axis=self.axis, causal=True,
-                                 use_pallas=self.use_pallas,
-                                 pallas_interpret=self.pallas_interpret)
+            attn = (ring_attention if self.sp_mode == "ring"
+                    else ulysses_attention)
+            att = attn(q, k, v, axis=self.axis, causal=True,
+                       use_pallas=self.use_pallas,
+                       pallas_interpret=self.pallas_interpret)
         else:
             # single-device fallback: dense causal attention
-            s = jnp.einsum("bihd,bjhd->bihj", q.astype(jnp.float32),
-                           k.astype(jnp.float32)) / jnp.sqrt(C // H)
-            mask = jnp.tril(jnp.ones((T, T), bool))
-            s = jnp.where(mask[None, :, None, :], s, -jnp.inf)
-            p = nn.softmax(s, axis=-1)
-            att = jnp.einsum("bihj,bjhd->bihd", p,
-                             v.astype(jnp.float32)).astype(self.dtype)
+            att = dense_attention(q, k, v, causal=True).astype(self.dtype)
         att = att.reshape(B, T, C)
         x = x + nn.Dense(C, use_bias=False, dtype=self.dtype)(att)
 
@@ -73,6 +76,7 @@ class RingTransformerLM(nn.Module):
     max_seq_len: int = 8192
     axis: Optional[str] = None
     dtype: Any = jnp.bfloat16
+    sp_mode: str = "ring"   # sequence-parallel mode: "ring" | "ulysses"
     remat: bool = False     # rematerialize blocks: trade FLOPs for HBM
     use_pallas: bool = False
     pallas_interpret: Optional[bool] = None
@@ -91,7 +95,7 @@ class RingTransformerLM(nn.Module):
         for _ in range(self.num_layers):
             x = Block(
                 num_heads=self.num_heads, axis=self.axis, dtype=self.dtype,
-                use_pallas=self.use_pallas,
+                sp_mode=self.sp_mode, use_pallas=self.use_pallas,
                 pallas_interpret=self.pallas_interpret)(x)
         x = nn.LayerNorm(dtype=jnp.float32)(x)
         return nn.Dense(self.vocab_size, use_bias=False,

@@ -17,6 +17,7 @@ from .collectives import (
     hierarchical_neighbor_allreduce,
 )
 from .ring import ring_pass, ring_allreduce, ring_attention
+from .ulysses import ulysses_attention, local_flash_attention
 
 __all__ = [
     "my_rank",
@@ -31,4 +32,6 @@ __all__ = [
     "ring_pass",
     "ring_allreduce",
     "ring_attention",
+    "ulysses_attention",
+    "local_flash_attention",
 ]

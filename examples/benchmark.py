@@ -139,6 +139,11 @@ def main():
             scheds = sch.compile_dynamic_schedules(gen, n)
 
     name = args.dist_optimizer
+    if args.wire and name in ("gradient_allreduce", "win_put", "pull_get",
+                              "push_sum", "allreduce", "empty"):
+        raise SystemExit(
+            f"--wire applies to the neighbor/hierarchical gossip "
+            f"strategies, not {name}")
     if name == "gradient_allreduce":
         strategy = bfopt.gradient_allreduce(opt)
     elif name == "win_put":

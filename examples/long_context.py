@@ -31,6 +31,10 @@ def main():
     parser.add_argument("--d-model", type=int, default=64)
     parser.add_argument("--lr", type=float, default=3e-3)
     parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--sp-mode", default="ring",
+                        choices=["ring", "ulysses"],
+                        help="sequence-parallel mode: K/V ring rotation or "
+                             "all-to-all head scatter (needs heads %% n == 0)")
     parser.add_argument("--use-pallas", action="store_true",
                         help="VMEM flash kernel for attention fwd+bwd "
                              "(interpret mode off-TPU: slow, test-only)")
@@ -59,9 +63,11 @@ def main():
     local_T = T // n
     vocab = 32
 
+    # ulysses scatters heads across the axis: give it one head per device
+    heads = n if args.sp_mode == "ulysses" else 2
     lm = models.RingTransformerLM(
-        vocab_size=vocab, num_layers=2, num_heads=2, d_model=args.d_model,
-        max_seq_len=T, axis="rank", dtype=jnp.float32,
+        vocab_size=vocab, num_layers=2, num_heads=heads, d_model=args.d_model,
+        max_seq_len=T, axis="rank", dtype=jnp.float32, sp_mode=args.sp_mode,
         use_pallas=args.use_pallas)
     params = lm.clone(axis=None).init(
         jax.random.key(args.seed), jnp.zeros((1, local_T), jnp.int32))
@@ -110,7 +116,7 @@ def main():
                   f"(seq {T} over {n} devices, {local_T}/device)")
 
     assert losses[-1] < losses[0], "no training progress through the ring"
-    print(f"[ring-SP] loss {losses[0]:.3f} -> {losses[-1]:.3f} on "
+    print(f"[{args.sp_mode}-SP] loss {losses[0]:.3f} -> {losses[-1]:.3f} on "
           f"{T}-token context sharded {n} ways")
 
 

@@ -21,6 +21,7 @@ from bluefog_tpu import optimizers as bfopt
 from bluefog_tpu import schedule as sch
 from bluefog_tpu import topology as tu
 from bluefog_tpu.ops import ring_attention
+from bluefog_tpu.ops import ulysses as ops_ulysses
 
 N = 8
 
@@ -282,3 +283,30 @@ def test_int8_wire_shrinks_permute_payload(tpu_mesh):
     # must be s8, and no full-precision f32 payload permute remains
     assert len(payload) == 3, [lines[l] for l in starts]
     assert not any(re.search(r"f32\[\d{4,}", lines[l]) for l in starts)
+
+
+def test_ulysses_kernels_lower_for_tpu(tpu_mesh):
+    """ulysses_attention(use_pallas) fwd+bwd compiles through Mosaic for
+    v5e, with the head/sequence re-shard lowering to all-to-all — the
+    second SP mode is a real TPU program too."""
+    # T and block_q sized to the backward kernel's VMEM budget: ulysses
+    # holds the FULL sequence locally (scores [block_q, T] on stack), unlike
+    # ring whose K/V chunks shrink with the mesh
+    B, T, H, D = 1, N * 256, 8, 64
+
+    def loss(q, k, v):
+        out = ops_ulysses.ulysses_attention(
+            q, k, v, axis="rank", causal=True, use_pallas=True,
+            pallas_block_q=256, pallas_interpret=False)
+        return jax.lax.psum(jnp.sum(out.astype(jnp.float32) ** 2), "rank")
+
+    g = jax.value_and_grad(loss, argnums=(0, 1, 2))
+    fn = jax.jit(jax.shard_map(
+        g, mesh=tpu_mesh, in_specs=(P(None, "rank"),) * 3,
+        out_specs=(P(), (P(None, "rank"),) * 3)))
+    sds = tuple(jax.ShapeDtypeStruct(
+        (B, T, H, D), jnp.bfloat16,
+        sharding=NamedSharding(tpu_mesh, P(None, "rank"))) for _ in range(3))
+    txt = fn.lower(*sds).compile().as_text()
+    assert txt.count("tpu_custom_call") == 2      # fwd + bwd Mosaic kernels
+    assert "all-to-all" in txt                    # the head/seq re-shard

@@ -194,6 +194,24 @@ def _pallas_ring_bwd(axis, causal, scale, block_q, interpret, res, g):
 _pallas_ring_attention.defvjp(_pallas_ring_fwd, _pallas_ring_bwd)
 
 
+def online_softmax_merge(o, l, m, s, vt):
+    """One flash-attention accumulation: fold the score block ``s`` (may
+    contain ``-inf`` masked entries) and value block ``vt`` into the running
+    ``(o, l, m)`` statistics.  Guards fully-masked rows (``m`` stays
+    ``-inf``, their ``p`` contributes 0) — shared by the ring and ulysses
+    jnp paths so the subtle numerics live in exactly one place."""
+    m_new = jnp.maximum(m, s.max(axis=-1))
+    # exp(-inf - -inf) guard: rows with no valid keys keep m = -inf
+    safe_m = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+    p = jnp.exp(s - safe_m[..., None])
+    p = jnp.where(jnp.isneginf(s), 0.0, p)
+    corr = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - safe_m))
+    l = l * corr + p.sum(axis=-1)
+    o = o * corr[..., None] + jnp.einsum(
+        "bihj,bjhd->bihd", p, vt.astype(jnp.float32))
+    return o, l, m_new
+
+
 def _jnp_ring_attention(q, k, v, axis: Axis, causal: bool, scale: float):
     n = lax.axis_size(axis)
     idx = lax.axis_index(axis)
@@ -218,16 +236,7 @@ def _jnp_ring_attention(q, k, v, axis: Axis, causal: bool, scale: float):
             k_pos = src * blk_k + jnp.arange(blk_k)
             mask = q_pos[:, None, None] >= k_pos[None, None, :]  # [Tq, 1, Tk]
             s = jnp.where(mask[None], s, -jnp.inf)
-        m_new = jnp.maximum(m, s.max(axis=-1))
-        # exp(-inf - -inf) guard: rows with no valid keys keep m = -inf
-        safe_m = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
-        p = jnp.exp(s - safe_m[..., None])
-        if causal:
-            p = jnp.where(jnp.isneginf(s), 0.0, p)
-        corr = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - safe_m))
-        l = l * corr + p.sum(axis=-1)
-        o = o * corr[..., None] + jnp.einsum(
-            "bihj,bjhd->bihd", p, vt.astype(jnp.float32))
+        o, l, m_new = online_softmax_merge(o, l, m, s, vt)
         kt = lax.ppermute(kt, axis, perm=perm)
         vt = lax.ppermute(vt, axis, perm=perm)
         return (o, l, m_new, kt, vt), None

@@ -26,6 +26,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from .ring import online_softmax_merge
+
 Axis = str
 
 
@@ -152,16 +154,7 @@ def _jnp_local_attention(q, k, v, causal: bool, scale: float,
             k_pos = c * chunk + jnp.arange(chunk)
             mask = q_pos[:, None, None] >= k_pos[None, None, :]
             s = jnp.where(mask[None], s, -jnp.inf)
-        m_new = jnp.maximum(m, s.max(axis=-1))
-        safe_m = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
-        p = jnp.exp(s - safe_m[..., None])
-        if causal:
-            p = jnp.where(jnp.isneginf(s), 0.0, p)
-        corr = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - safe_m))
-        l = l * corr + p.sum(axis=-1)
-        o = o * corr[..., None] + jnp.einsum(
-            "bihj,bjhd->bihd", p, vt.astype(jnp.float32))
-        return (o, l, m_new), None
+        return online_softmax_merge(o, l, m, s, vt), None
 
     (o, l, _), _ = lax.scan(step, (o0, l0, m0), (jnp.arange(C), kc, vc))
     l = jnp.where(l == 0.0, 1.0, l)

@@ -64,6 +64,10 @@ def main():
     vocab = 32
 
     # ulysses scatters heads across the axis: give it one head per device
+    if args.sp_mode == "ulysses" and args.d_model % n:
+        raise SystemExit(
+            f"--sp-mode ulysses needs --d-model divisible by the device "
+            f"count ({n}); got {args.d_model}")
     heads = n if args.sp_mode == "ulysses" else 2
     lm = models.RingTransformerLM(
         vocab_size=vocab, num_layers=2, num_heads=heads, d_model=args.d_model,

@@ -46,7 +46,7 @@ from .api import (
     allreduce, allgather, ragged_allgather, broadcast,
     neighbor_allreduce, neighbor_allgather, ragged_neighbor_allgather,
     pair_gossip, hierarchical_neighbor_allreduce,
-    barrier, synchronize, poll, resolve_schedule, shard_distributed,
+    barrier, synchronize, poll, hard_sync, resolve_schedule, shard_distributed,
 )
 
 __version__ = "0.1.0"

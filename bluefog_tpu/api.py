@@ -374,6 +374,27 @@ def poll(x) -> bool:
     return all(leaf.is_ready() for leaf in leaves if hasattr(leaf, "is_ready"))
 
 
+def hard_sync(x):
+    """Device-to-host barrier: returns ``x`` only after every computation
+    producing it has actually finished on the device.
+
+    ``jax.block_until_ready`` trusts the runtime's ready event; some PJRT
+    plugins (the axon TPU tunnel among them) mark buffers ready at dispatch
+    time, which silently turns timing loops into *dispatch-rate*
+    measurements (observed: "28 PFLOP/s" matmuls).  A host transfer cannot
+    complete before the producing program has, so fetching one element of
+    each leaf is a true synchronization point on every backend.  Use this —
+    never ``block_until_ready`` — around benchmark timing sections.
+    """
+    for leaf in jax.tree_util.tree_leaves(x):
+        if isinstance(leaf, jax.Array):
+            # single-element index, not ravel(): a dynamic-slice costs O(1),
+            # where ravel dispatches a full-buffer copy inside the timed
+            # window this barrier is meant to close
+            jax.device_get(leaf if leaf.ndim == 0 else leaf[(0,) * leaf.ndim])
+    return x
+
+
 def barrier():
     """Synchronize all pending work (reference: ``bf.barrier``).
 

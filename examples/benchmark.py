@@ -169,13 +169,13 @@ def main():
     batch = (xb, yb)
     for _ in range(args.num_warmup):
         dist_params, dist_state, loss = step(dist_params, dist_state, batch)
-    jax.block_until_ready(loss)
+    bf.hard_sync(loss)      # host-transfer barrier: see bf.hard_sync
 
     t0 = time.perf_counter()
     with timeline.timeline_context("benchmark", "TRAIN"):
         for _ in range(args.num_iters):
             dist_params, dist_state, loss = step(dist_params, dist_state, batch)
-        jax.block_until_ready(loss)
+        bf.hard_sync(loss)
     dt = time.perf_counter() - t0
 
     if args.profile:

@@ -1,0 +1,57 @@
+"""Chip calibration: measured matmul FLOP rate and HBM bandwidth.
+
+Establishes the *achievable* ceilings on the attached accelerator — the
+denominators that make MFU and bandwidth-utilization claims in
+docs/PERFORMANCE.md concrete.  Also the regression probe for the timing
+methodology: if the reported TFLOP/s exceeds the device's spec sheet, the
+synchronization barrier is broken (see ``bf.hard_sync`` — on the axon PJRT
+plugin ``block_until_ready`` returns at dispatch, which once produced a
+"28 PFLOP/s matmul" here).
+
+Run:  python tools/chip_calibrate.py        (single client on the tunnel)
+Prints one JSON line per probe.
+"""
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, ".")
+from bluefog_tpu.api import hard_sync  # noqa: E402
+
+
+def main():
+    d = jax.devices()[0]
+    print(json.dumps({"probe": "device", "kind": d.device_kind,
+                      "platform": d.platform}))
+
+    for n in (4096, 8192):
+        a = jnp.ones((n, n), jnp.bfloat16)
+        f = jax.jit(lambda a, b: a @ b)
+        c = hard_sync(f(a, a))
+        iters = 50
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            c = f(a, c)           # chained: no inter-call overlap ambiguity
+        hard_sync(c)
+        dt = (time.perf_counter() - t0) / iters
+        print(json.dumps({
+            "probe": f"matmul_bf16_{n}", "ms": round(dt * 1e3, 3),
+            "tflops": round(2 * n ** 3 / dt / 1e12, 1)}))
+
+    x = jnp.ones((2 ** 28,), jnp.float32)          # 1 GiB
+    g = jax.jit(lambda x: x * 1.0001)
+    y = hard_sync(g(x))
+    t0 = time.perf_counter()
+    for _ in range(20):
+        y = g(y)
+    hard_sync(y)
+    dt = (time.perf_counter() - t0) / 20
+    print(json.dumps({"probe": "hbm_rw_1GiB", "ms": round(dt * 1e3, 3),
+                      "gbps": round(2 * 2 ** 30 / dt / 1e9)}))
+
+
+if __name__ == "__main__":
+    main()

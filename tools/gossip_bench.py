@@ -67,10 +67,11 @@ def main():
         fn = jax.jit(jax.shard_map(
             lambda t: bf.ops.neighbor_allreduce(t[0], schedule)[None],
             mesh=bf.mesh(), in_specs=P("rank"), out_specs=P("rank")))
-        out = jax.block_until_ready(fn(x))
+        out = bf.hard_sync(fn(x))
         t0 = time.perf_counter()
         for _ in range(args.iters):
-            out = jax.block_until_ready(fn(out))
+            out = fn(out)
+        bf.hard_sync(out)
         return (time.perf_counter() - t0) / args.iters * 1e3
 
     for name, topo in topologies.items():
@@ -83,10 +84,11 @@ def main():
     fn = jax.jit(jax.shard_map(
         lambda t: bf.ops.allreduce(t[0])[None],
         mesh=bf.mesh(), in_specs=P("rank"), out_specs=P("rank")))
-    out = jax.block_until_ready(fn(x))
+    out = bf.hard_sync(fn(x))
     t0 = time.perf_counter()
     for _ in range(args.iters):
-        out = jax.block_until_ready(fn(out))
+        out = fn(out)
+    bf.hard_sync(out)
     ar_ms = (time.perf_counter() - t0) / args.iters * 1e3
 
     print(f"{n} devices, {args.params} f32/rank "
@@ -143,11 +145,11 @@ def _train_step_comparison(args, bf, n):
         step = bfopt.make_train_step(grad_fn, strat, steps_per_call=steps)
         batch = tuple(jnp.zeros((n, steps, bsz, dim)) for _ in range(2))
         params, state, loss = step(params, state, batch)   # compile
-        jax.block_until_ready(loss)
+        bf.hard_sync(loss)
         t0 = time.perf_counter()
         for _ in range(args.iters):
             params, state, loss = step(params, state, batch)
-            jax.block_until_ready(loss)
+        bf.hard_sync(loss)
         ms = (time.perf_counter() - t0) / (args.iters * steps) * 1e3
         print(f"{name:>22} {ms:>9.2f}")
 

@@ -156,7 +156,7 @@ def main():
     # compile ONCE via AOT and reuse the executable for both the FLOP
     # accounting and the benchmark loop (a second jit compile of ResNet-50
     # costs minutes on TPU)
-    xla_flops_per_step = None
+    xla_flops_per_call = None
     try:
         compiled = step.lower(dist_params, dist_state, data).compile()
         ca = compiled.cost_analysis()
@@ -164,13 +164,15 @@ def main():
             ca = ca[0]
         f = float(ca.get("flops", 0.0))
         if f > 0:
-            xla_flops_per_step = f
+            xla_flops_per_call = f
         step = compiled
     except Exception:
         pass                      # fall back to the jit path
     # MFU uses analytic *model* FLOPs (the convention): ResNet-50 fwd
     # ~4.09 GFLOP/img, train ~3x.  XLA's cost_analysis count (reported
-    # alongside) runs ~2x that — it includes non-model work.
+    # alongside as xla_call_flops) covers the whole steps_per_call-step
+    # scan and includes non-model work, so it runs ~2x steps_per_call
+    # times the per-step analytic number.
     flops_per_call = 3 * 4.089e9 * batch * n * steps_per_call
 
     # warmup (compiles here only if the AOT path failed); hard_sync, not
@@ -205,7 +207,7 @@ def main():
         "mfu": round(mfu, 4) if mfu is not None else None,
         "steps_per_call": steps_per_call,
         "step_flops": flops_per_call / steps_per_call,
-        "xla_call_flops": xla_flops_per_step,
+        "xla_call_flops": xla_flops_per_call,
     }))
 
 

@@ -1,0 +1,151 @@
+"""ZeRO-1 sharded-optimizer-state strategies.
+
+Beyond-reference capability (the reference replicates optimizer state on
+every rank, ``optimizers.py:166-294``): grads reduce-scatter, the local
+1/n shard steps, params all-gather.  Oracles: exact trajectory equality
+with the replicated strategy (the adapt is elementwise, so sharding it
+must be a no-op mathematically), shard-sized state leaves, and hierarchical
+convergence with the within-machine-identity invariant.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import optax
+import pytest
+
+import bluefog_tpu as bf
+from bluefog_tpu import optimizers as bfopt
+from bluefog_tpu import topology as tu
+
+N, D = 8, 6
+
+
+@pytest.fixture(autouse=True)
+def ctx(cpu_devices):
+    bf.init(devices=cpu_devices, nodes_per_machine=2)
+    bf.set_topology(tu.ExponentialTwoGraph(N), is_weighted=True)
+    bf.set_machine_topology(tu.RingGraph(N // 2, connect_style=0),
+                            is_weighted=True)
+    yield
+    bf.shutdown()
+
+
+def _problem(seed=0):
+    rng = np.random.default_rng(seed)
+    w_star = rng.normal(size=(D,))
+    A = rng.normal(size=(N, 20, D))
+    b = A @ w_star + 0.1 * rng.normal(size=(N, 20))
+    AtA = sum(A[r].T @ A[r] for r in range(N))
+    Atb = sum(A[r].T @ b[r] for r in range(N))
+    return (jnp.asarray(A, jnp.float32), jnp.asarray(b, jnp.float32),
+            np.linalg.solve(AtA, Atb))
+
+
+def grad_fn(params, batch):
+    A, b = batch
+
+    def loss(w):
+        r = A @ w["w"] - b
+        # the bf16 leaf joins the loss so it carries a real (bf16) gradient,
+        # exercising the per-dtype fusion buckets in the ZeRO path
+        return jnp.mean(r * r) + 1e-4 * jnp.sum(
+            w["w16"].astype(jnp.float32) ** 2)
+
+    return jax.value_and_grad(loss)(params)
+
+
+def _params():
+    return {"w": jnp.zeros((D,), jnp.float32),
+            "w16": jnp.ones((5,), jnp.bfloat16)}
+
+
+def _run(strategy, steps=100, chunk=25, seed=0):
+    A, b, w_opt = _problem(seed)
+    dist_params = bfopt.replicate(_params())
+    dist_state = bfopt.init_distributed(strategy, dist_params)
+    step = bfopt.make_train_step(grad_fn, strategy, steps_per_call=chunk)
+    batch = jax.tree.map(
+        lambda x: jnp.broadcast_to(x[:, None], (N, chunk) + x.shape[1:]),
+        (A, b))
+    for _ in range(steps // chunk):
+        dist_params, dist_state, loss = step(dist_params, dist_state, batch)
+        jax.block_until_ready(loss)
+    return dist_params, w_opt
+
+
+def test_zero_matches_gradient_allreduce():
+    """Sharding the (elementwise) adapt is exact: same trajectory as the
+    replicated strategy, down to float tolerance — including the padded
+    bucket (D=6 over 8 ranks pads to 8) and the bf16 bucket."""
+    p_zero, w_opt = _run(bfopt.zero_gradient_allreduce(
+        optax.adam(0.05)))
+    p_full, _ = _run(bfopt.gradient_allreduce(optax.adam(0.05)))
+    np.testing.assert_allclose(np.asarray(p_zero["w"]),
+                               np.asarray(p_full["w"]), rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(
+        np.asarray(p_zero["w16"], np.float32),
+        np.asarray(p_full["w16"], np.float32), rtol=0.05, atol=0.02)
+    # and it actually optimizes
+    for r in range(N):
+        np.testing.assert_allclose(np.asarray(p_zero["w"])[r], w_opt,
+                                   atol=0.05)
+
+
+def test_zero_state_is_sharded():
+    """Optimizer-state leaves hold 1/n of the (padded) parameter count."""
+    strat = bfopt.zero_gradient_allreduce(optax.adam(0.05))
+    state = strat.init(_params())
+    mu = state.opt_state[0].mu           # list of per-dtype shard buffers
+    sizes = sorted(leaf.size for leaf in jax.tree.leaves(mu))
+    # bf16 bucket: ceil(5/8) -> pad to 8, shard 1; f32 bucket: 6 -> pad 8 -> 1
+    assert sizes == [1, 1]
+    full = bfopt.gradient_allreduce(optax.adam(0.05)).init(_params())
+    full_sizes = sorted(leaf.size
+                        for leaf in jax.tree.leaves(full.opt_state[0].mu))
+    assert full_sizes == [5, 6]
+
+
+def test_zero_adapt_with_combine_hierarchical():
+    """Machine-level gossip + within-machine ZeRO: converges to the global
+    optimum, and every chip in a machine holds identical params (the
+    all-gather reassembles one shared update per machine)."""
+    comm = bfopt.hierarchical_communicator(bf.machine_schedule())
+    strat = bfopt.zero_adapt_with_combine(optax.sgd(0.05), comm)
+    dist_params, w_opt = _run(strat, steps=300, chunk=50)
+    w = np.asarray(dist_params["w"])
+    for r in range(N):
+        np.testing.assert_allclose(w[r], w_opt, atol=0.15)
+    # rank layout is machine-major (nodes_per_machine=2)
+    for m in range(N // 2):
+        np.testing.assert_array_equal(w[2 * m], w[2 * m + 1])
+
+
+def test_zero_single_rank_degenerate(cpu_devices):
+    """n=1 mesh: psum_scatter/all_gather are identities; still steps."""
+    bf.shutdown()
+    bf.init(devices=cpu_devices[:1], nodes_per_machine=1)
+    strat = bfopt.zero_gradient_allreduce(optax.sgd(0.1))
+    params = {"w": jnp.ones((1, 3), jnp.float32)}
+    state = bfopt.init_distributed(strat, params)
+
+    def gf(p, _):
+        return jnp.sum(p["w"] ** 2), {"w": 2 * p["w"]}
+
+    step = bfopt.make_train_step(gf, strat)
+    params, state, loss = step(params, state, jnp.zeros((1, 1)))
+    np.testing.assert_allclose(np.asarray(params["w"]), 0.8 * np.ones((1, 3)))
+
+
+def test_zero_local_axis_plumbs_2d_mesh():
+    """zero_gradient_allreduce(axis='local'): per-machine synchronous DP
+    with no cross-machine traffic — the strategy must carry the 2-D axes so
+    make_train_step builds the machine x local mesh (round-2 review fix)."""
+    strat = bfopt.zero_gradient_allreduce(optax.sgd(0.05), axis="local")
+    assert strat.axes == ("machine", "local")
+    dist_params, _ = _run(strat, steps=25, chunk=25)
+    w = np.asarray(dist_params["w"])
+    assert np.isfinite(w).all()
+    for m in range(N // 2):            # identical within each machine...
+        np.testing.assert_array_equal(w[2 * m], w[2 * m + 1])
+    # ...but machines see different data shards, so they diverge
+    assert not np.allclose(w[0], w[2])

@@ -27,6 +27,7 @@ def main():
                         choices=["resnet50", "resnet18", "vgg16", "vgg11", "cnn", "mlp"])
     parser.add_argument("--dist-optimizer", default="neighbor_allreduce",
                         choices=["neighbor_allreduce", "gradient_allreduce",
+                                 "zero_allreduce",
                                  "allreduce", "hierarchical_neighbor_allreduce",
                                  "win_put", "pull_get", "push_sum", "empty"])
     parser.add_argument("--atc", action="store_true")
@@ -139,13 +140,17 @@ def main():
             scheds = sch.compile_dynamic_schedules(gen, n)
 
     name = args.dist_optimizer
-    if args.wire and name in ("gradient_allreduce", "win_put", "pull_get",
+    if args.wire and name in ("gradient_allreduce", "zero_allreduce",
+                              "win_put", "pull_get",
                               "push_sum", "allreduce", "empty"):
         raise SystemExit(
             f"--wire applies to the neighbor/hierarchical gossip "
             f"strategies, not {name}")
     if name == "gradient_allreduce":
         strategy = bfopt.gradient_allreduce(opt)
+    elif name == "zero_allreduce":
+        # ZeRO-1: same trajectory as gradient_allreduce, 1/n optimizer state
+        strategy = bfopt.zero_gradient_allreduce(opt)
     elif name == "win_put":
         strategy = bfopt.DistributedWinPutOptimizer(opt)
     elif name == "pull_get":

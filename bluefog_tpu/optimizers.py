@@ -501,6 +501,91 @@ def push_sum(
     return DecentralizedOptimizer(init, update)
 
 
+def push_schedule(topo=None, size: Optional[int] = None) -> CommSchedule:
+    """Column-stochastic push schedule: sender j keeps and sends
+    ``1/(outdeg_j + 1)`` of its mass on every out-edge.  The receive weight
+    of edge (j -> i) therefore depends on the *sender's* out-degree — the
+    weight family push-sum/push-DIGing need on directed, unbalanced graphs
+    (reference usage: ``examples/pytorch_optimization.py:371-433``).
+    """
+    from . import topology as _topo
+    if topo is None:
+        topo = _mesh.load_topology()
+    n = size if size is not None else topo.number_of_nodes()
+    keep = [1.0 / (len(_topo.GetOutNeighbors(topo, r)) + 1.0)
+            for r in range(n)]
+    src = [{s: keep[s] for s in _topo.GetInNeighbors(topo, r)}
+           for r in range(n)]
+    from .schedule import compile_from_weights
+    return compile_from_weights(n, keep, src)
+
+
+def push_diging(
+    opt: optax.GradientTransformation,
+    sched: Optional[CommSchedule] = None,
+    *,
+    axis: Axis = "rank",
+    axes: Tuple[str, ...] = ("rank",),
+    fuse: bool = True,
+) -> DecentralizedOptimizer:
+    """Push-DIGing: gradient tracking on directed graphs via push-sum.
+
+    Reference algorithm library: ``examples/pytorch_optimization.py:371``
+    (Nedic et al., "Achieving geometric convergence for distributed
+    optimization over time-varying graphs").  Gradient tracking
+    (:func:`gradient_tracking`) needs doubly-stochastic mixing; on a
+    directed graph only *column*-stochastic push weights ``C`` are
+    available, so the iterate rides a biased channel ``u`` with a mass
+    lane ``p`` de-biasing it:
+
+        y_t     = C(y_{t-1}) + g(z_t) - g(z_{t-1})     (tracker)
+        u_{t+1} = C(u_t + A(y_t))                      (push mixing)
+        p_{t+1} = C(p_t)
+        z_{t+1} = u_{t+1} / p_{t+1}                    (de-biased = params)
+
+    The params the train step carries are always the de-biased ``z``, so
+    the user's grad_fn never sees the mass bias.  ``comm_state`` holds
+    ``(u, p, y, g_prev)`` with ``u, p`` in fused per-dtype buffers.
+    """
+    def _sched():
+        return sched if sched is not None else push_schedule()
+
+    def _bufs(tree):
+        return fusion.fuse_tree(tree).buffers if fuse else tree
+
+    def init(params):
+        u0 = _bufs(jax.tree.map(jnp.copy, params))
+        p0 = jax.tree.map(lambda x: jnp.ones((), x.dtype), u0)
+        zeros = jax.tree.map(jnp.zeros_like, params)
+        return DecentralizedState(
+            jnp.zeros((), jnp.int32), opt.init(params),
+            (u0, p0, zeros, zeros))
+
+    def update(grads, state, params):
+        s = _sched()
+        u, p, y, g_prev = state.comm_state
+        nar = lambda t: jax.tree.map(
+            lambda x: ops.neighbor_allreduce(x, s, axis=axis), t)
+        with jax.named_scope("COMMUNICATE"):
+            y = nar(y)
+        y = jax.tree.map(lambda a, g, gp: a + g - gp, y, grads, g_prev)
+        with jax.named_scope("ADAPT"):
+            updates, opt_state = opt.update(y, state.opt_state, params)
+        step_tree = _bufs(updates)
+        with jax.named_scope("COMMUNICATE"):
+            u = nar(jax.tree.map(jnp.add, u, step_tree))
+            p = nar(p)
+        recipe = fusion.fuse_tree(params) if fuse else None
+        z = jax.tree.map(lambda a, b: a / b, u, p)
+        if fuse:
+            recipe.buffers = z
+            z = recipe.unfuse()
+        return z, DecentralizedState(
+            state.step + 1, opt_state, (u, p, y, grads))
+
+    return DecentralizedOptimizer(init, update, axes)
+
+
 def exact_diffusion(
     opt: optax.GradientTransformation,
     comm: Communicator,

@@ -27,7 +27,7 @@ def main():
                         choices=["resnet50", "resnet18", "vgg16", "vgg11", "cnn", "mlp"])
     parser.add_argument("--dist-optimizer", default="neighbor_allreduce",
                         choices=["neighbor_allreduce", "gradient_allreduce",
-                                 "zero_allreduce",
+                                 "zero_allreduce", "choco",
                                  "allreduce", "hierarchical_neighbor_allreduce",
                                  "win_put", "pull_get", "push_sum", "empty"])
     parser.add_argument("--atc", action="store_true")
@@ -151,6 +151,9 @@ def main():
     elif name == "zero_allreduce":
         # ZeRO-1: same trajectory as gradient_allreduce, 1/n optimizer state
         strategy = bfopt.zero_gradient_allreduce(opt)
+    elif name == "choco":
+        # error-compensated compressed gossip (defaults to int8 wire)
+        strategy = bfopt.choco_gossip(opt, wire=args.wire or "int8")
     elif name == "win_put":
         strategy = bfopt.DistributedWinPutOptimizer(opt)
     elif name == "pull_get":

@@ -16,7 +16,8 @@ from .collectives import (
     pair_gossip,
     hierarchical_neighbor_allreduce,
 )
-from .ring import ring_pass, ring_allreduce, ring_attention
+from .ring import (ring_pass, ring_allreduce, ring_attention,
+                   zigzag_order, zigzag_inverse)
 from .ulysses import ulysses_attention, local_flash_attention
 
 __all__ = [
@@ -32,6 +33,8 @@ __all__ = [
     "ring_pass",
     "ring_allreduce",
     "ring_attention",
+    "zigzag_order",
+    "zigzag_inverse",
     "ulysses_attention",
     "local_flash_attention",
 ]

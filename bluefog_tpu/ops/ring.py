@@ -67,6 +67,7 @@ def ring_attention(
     use_pallas: bool = False,
     pallas_block_q: int = 512,
     pallas_interpret: Optional[bool] = None,
+    layout: str = "contiguous",
 ) -> jax.Array:
     """Exact attention over a sequence sharded along ``axis``.
 
@@ -82,21 +83,182 @@ def ring_attention(
     ``pallas_interpret`` overrides the auto-detection (which keys off
     ``jax.default_backend()``): pass ``False`` when AOT-compiling for a TPU
     topology from a CPU host, where the default backend is not the target.
+
+    ``layout="zigzag"`` (causal only) expects the sequence sharded in the
+    *balanced* order (:func:`zigzag_order`): device i holds chunks
+    ``(i, 2n-1-i)``, so every device computes exactly two chunk-pair
+    partials per ring step — the contiguous layout leaves early devices
+    idle while the last device computes every block, so its causal wall
+    clock is ~2x this one at scale ("striped" ring attention).
     """
     if q.ndim != 4:
         raise ValueError("expected [batch, block_len, heads, head_dim]")
-    n = lax.axis_size(axis)
-    idx = lax.axis_index(axis)
     d = q.shape[-1]
     if scale is None:
         scale = 1.0 / np.sqrt(d)
-    blk_q, blk_k = q.shape[1], k.shape[1]
+    if layout not in ("contiguous", "zigzag"):
+        raise ValueError(f"unknown layout {layout!r}")
+    if layout == "zigzag":
+        if not causal:
+            raise ValueError(
+                "zigzag layout only pays for causal attention; use the "
+                "contiguous layout for bidirectional")
+        if q.shape[1] % 2:
+            raise ValueError("zigzag needs an even per-device block length "
+                             "(two chunks per device)")
+        if k.shape[1] != q.shape[1] or v.shape[1] != q.shape[1]:
+            raise ValueError(
+                "zigzag needs equal q/k/v block lengths (the chunk ids that "
+                "drive the visibility table assume one shard layout)")
+        if use_pallas:
+            return _zigzag_pallas(q, k, v, axis, float(scale),
+                                  pallas_block_q, pallas_interpret)
+        return _zigzag_impl(q, k, v, axis, float(scale), False, 0, None)
 
     if use_pallas:
         return _pallas_ring_attention(
             q, k, v, axis, causal, float(scale), pallas_block_q,
             pallas_interpret)
     return _jnp_ring_attention(q, k, v, axis, causal, float(scale))
+
+
+def zigzag_order(n: int, total_len: int) -> np.ndarray:
+    """Permutation putting a contiguous sequence into the zigzag layout.
+
+    ``tokens[zigzag_order(n, T)]`` reordered then sharded contiguously over
+    ``n`` devices gives device i chunks ``(i, 2n-1-i)`` of the original
+    sequence.  Invert with :func:`zigzag_inverse`.
+    """
+    if total_len % (2 * n):
+        raise ValueError(f"sequence length {total_len} not divisible by 2n")
+    C = total_len // (2 * n)
+    chunks = np.arange(total_len).reshape(2 * n, C)
+    order = [c for i in range(n) for c in (chunks[i], chunks[2 * n - 1 - i])]
+    return np.concatenate(order)
+
+
+def zigzag_inverse(n: int, total_len: int) -> np.ndarray:
+    """Inverse permutation of :func:`zigzag_order` (zigzag -> contiguous)."""
+    return np.argsort(zigzag_order(n, total_len))
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _zigzag_pallas(q, k, v, axis: Axis, scale: float, block_q: int,
+                   interpret: Optional[bool]):
+    """Zigzag forward through the Pallas partials; backward recomputes
+    through the jnp formulation (the flash recurrence keeps the forward's
+    memory profile; the backward trades one extra scores materialization
+    per C x C chunk pair for kernel simplicity — a dedicated zigzag
+    backward kernel is a further optimization, not a correctness need)."""
+    return _zigzag_impl(q, k, v, axis, scale, True, block_q, interpret)
+
+
+def _zigzag_pallas_fwd(q, k, v, axis, scale, block_q, interpret):
+    out = _zigzag_impl(q, k, v, axis, scale, True, block_q, interpret)
+    return out, (q, k, v)
+
+
+def _zigzag_pallas_bwd(axis, scale, block_q, interpret, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: _zigzag_impl(q_, k_, v_, axis, scale,
+                                        False, 0, None), q, k, v)
+    return vjp(g)
+
+
+_zigzag_pallas.defvjp(_zigzag_pallas_fwd, _zigzag_pallas_bwd)
+
+
+def _zigzag_impl(q, k, v, axis: Axis, scale: float,
+                 use_pallas: bool, block_q: int,
+                 interpret: Optional[bool]):
+    """Balanced causal ring attention over the zigzag shard.
+
+    Device i's local block is ``[chunk_lo = i, chunk_hi = 2n-1-i]`` (C rows
+    each).  With K/V from source s, chunk-pair visibility under the causal
+    mask is fixed by chunk ids (pair fully masked iff q_chunk < k_chunk):
+
+        q_lo x k_lo : visible iff i >= s      (lax.cond)
+        q_lo x k_hi : never  (i + s <= 2n-2 < 2n-1-s's floor) — skipped
+        q_hi x k_lo : always (2n-1-i >= n > s)
+        q_hi x k_hi : visible iff s >= i      (lax.cond)
+
+    so every device computes exactly 2 C x C partials per step (3 at t=0)
+    — balanced, where the contiguous layout loads the last device with
+    every block.  Grads flow by autodiff through the scan/cond (the pallas
+    partial has its own recompute rule via the flash recurrence).
+    """
+    from . import pallas_attention as pa
+
+    n = lax.axis_size(axis)
+    idx = lax.axis_index(axis)
+    C = q.shape[1] // 2
+    perm = _ring_perm(n, 1)
+    B, _, H, D = q.shape
+
+    def _partial(qc, kc, vc, q_off, k_off, masked: bool = True):
+        """One C x C partial (o, l, m) via pallas or jnp.  ``masked=False``
+        for pairs strictly below the diagonal (q_hi x k_lo), where the
+        causal mask is provably all-true — skip building it."""
+        if use_pallas:
+            return pa.attention_block_partial(
+                qc, kc, vc, q_off, k_off, causal=masked, scale=scale,
+                block_q=block_q, interpret=interpret)
+        qf = qc.astype(jnp.float32) * scale
+        s = jnp.einsum("bihd,bjhd->bihj", qf, kc.astype(jnp.float32))
+        if masked:
+            q_pos = q_off + jnp.arange(C)
+            k_pos = k_off + jnp.arange(C)
+            mask = q_pos[:, None] >= k_pos[None, :]
+            s = jnp.where(mask[None, :, None, :], s, -jnp.inf)
+        # fold into fresh flash state: the shared merge keeps the masked-row
+        # numerics (safe-m, p zeroing) in exactly one place
+        B_, Cq = qc.shape[0], qc.shape[1]
+        H_ = qc.shape[2]
+        o0 = jnp.zeros((B_, Cq, H_, vc.shape[-1]), jnp.float32)
+        l0 = jnp.zeros((B_, Cq, H_), jnp.float32)
+        m0 = jnp.full((B_, Cq, H_), -jnp.inf, jnp.float32)
+        return online_softmax_merge(o0, l0, m0, s, vc)
+
+    def _merge_if(pred, olm, qc, kc, vc, q_off, k_off):
+        def do(state):
+            return pa.merge_partials(state, _partial(qc, kc, vc, q_off, k_off))
+        return lax.cond(pred, do, lambda state: state, olm)
+
+    def _zeros_olm():
+        o = lax.pcast(jnp.zeros((B, C, H, D), jnp.float32), axis, to='varying')
+        l = lax.pcast(jnp.zeros((B, C, H), jnp.float32), axis, to='varying')
+        m = lax.pcast(jnp.full((B, C, H), -jnp.inf, jnp.float32), axis,
+                      to='varying')
+        return o, l, m
+
+    q_lo, q_hi = q[:, :C], q[:, C:]
+    off_lo = idx * C
+    off_hi = (2 * n - 1 - idx) * C
+
+    def step(carry, t):
+        lo, hi, kt, vt = carry
+        src = (idx - t) % n
+        k_lo, k_hi = kt[:, :C], kt[:, C:]
+        v_lo, v_hi = vt[:, :C], vt[:, C:]
+        koff_lo = src * C
+        koff_hi = (2 * n - 1 - src) * C
+        lo = _merge_if(idx >= src, lo, q_lo, k_lo, v_lo, off_lo, koff_lo)
+        hi = pa.merge_partials(
+            hi, _partial(q_hi, k_lo, v_lo, off_hi, koff_lo, masked=False))
+        hi = _merge_if(src >= idx, hi, q_hi, k_hi, v_hi, off_hi, koff_hi)
+        kt = lax.ppermute(kt, axis, perm=perm)
+        vt = lax.ppermute(vt, axis, perm=perm)
+        return (lo, hi, kt, vt), None
+
+    (lo, hi, _, _), _ = lax.scan(
+        step, (_zeros_olm(), _zeros_olm(), k, v), jnp.arange(n))
+
+    def _norm(olm):
+        o, l, m = olm
+        return o / jnp.where(l == 0.0, 1.0, l)[..., None]
+
+    return jnp.concatenate([_norm(lo), _norm(hi)], axis=1).astype(q.dtype)
 
 
 def _pallas_forward(q, k, v, axis: Axis, causal: bool, scale: float,

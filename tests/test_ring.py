@@ -5,7 +5,9 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 import pytest
 
+import bluefog_tpu as bf
 from bluefog_tpu import ops
+from bluefog_tpu.ops import ring_attention
 
 N = 8
 
@@ -74,3 +76,123 @@ def test_vgg_forward_shapes():
     params = m.init(jax.random.key(0), x, train=False)
     out = m.apply(params, x, train=False)
     assert out.shape == (2, 10) and out.dtype == jnp.float32
+
+
+class TestZigzag:
+    """Balanced ("striped") causal ring attention over the zigzag shard."""
+
+    def _data(self, seed, B=1, T=None, H=2, D=4):
+        T = T or (2 * 8 * 3)        # n=8 devices, chunk C=3
+        rng = np.random.default_rng(seed)
+        return tuple(jnp.asarray(rng.normal(size=(B, T, H, D)), jnp.float32)
+                     for _ in range(3))
+
+    def _dense(self, q, k, v):
+        d = q.shape[-1]
+        s = np.einsum("bihd,bjhd->bihj", np.asarray(q, np.float64),
+                      np.asarray(k, np.float64)) / np.sqrt(d)
+        T = q.shape[1]
+        mask = np.arange(T)[:, None] >= np.arange(T)[None, :]
+        s = np.where(mask[None, :, None, :], s, -np.inf)
+        s = s - np.where(np.isinf(s.max(-1, keepdims=True)), 0,
+                         s.max(-1, keepdims=True))
+        p = np.exp(s)
+        return np.einsum("bihj,bjhd->bihd", p / p.sum(-1, keepdims=True),
+                         np.asarray(v, np.float64))
+
+    def test_order_roundtrip(self):
+        n, T = 8, 48
+        fwd = ops.zigzag_order(n, T)
+        inv = ops.zigzag_inverse(n, T)
+        np.testing.assert_array_equal(fwd[inv], np.arange(T))
+        # device 0's slice = chunks 0 and 15 of the contiguous sequence
+        np.testing.assert_array_equal(fwd[:3], [0, 1, 2])
+        np.testing.assert_array_equal(fwd[3:6], [45, 46, 47])
+
+    @pytest.mark.parametrize("use_pallas", [False, True])
+    def test_matches_dense_oracle(self, cpu_devices, use_pallas):
+        bf.init(devices=cpu_devices, nodes_per_machine=1)
+        try:
+            q, k, v = self._data(10)
+            T = q.shape[1]
+            order = ops.zigzag_order(N, T)
+            inv = ops.zigzag_inverse(N, T)
+
+            def f(qb, kb, vb):
+                return ring_attention(
+                    qb, kb, vb, axis="rank", causal=True, layout="zigzag",
+                    use_pallas=use_pallas)
+
+            fn = jax.jit(jax.shard_map(
+                f, mesh=bf.mesh(), in_specs=(P(None, "rank"),) * 3,
+                out_specs=P(None, "rank"), check_vma=not use_pallas))
+            out_z = fn(q[:, order], k[:, order], v[:, order])
+            out = np.asarray(out_z)[:, inv]
+            np.testing.assert_allclose(out, self._dense(q, k, v),
+                                       rtol=1e-4, atol=1e-5)
+        finally:
+            bf.shutdown()
+
+    def test_grads_match_contiguous_path(self, cpu_devices):
+        """d/dq,k,v of sum(out^2) equals the contiguous ring's grads after
+        un-permuting — zigzag is the same math, re-sharded."""
+        bf.init(devices=cpu_devices, nodes_per_machine=1)
+        try:
+            q, k, v = self._data(11, H=1, D=4)
+            T = q.shape[1]
+            order = ops.zigzag_order(N, T)
+            inv = ops.zigzag_inverse(N, T)
+
+            def make(layout, use_pallas=False):
+                def loss(qb, kb, vb):
+                    out = ring_attention(
+                        qb, kb, vb, axis="rank", causal=True, layout=layout,
+                        use_pallas=use_pallas)
+                    return jax.lax.psum(jnp.sum(out ** 2), "rank")
+                g = jax.grad(loss, argnums=(0, 1, 2))
+                return jax.jit(jax.shard_map(
+                    g, mesh=bf.mesh(), in_specs=(P(None, "rank"),) * 3,
+                    out_specs=(P(None, "rank"),) * 3, check_vma=False))
+
+            g_c = make("contiguous")(q, k, v)
+            g_z = make("zigzag")(q[:, order], k[:, order], v[:, order])
+            g_zp = make("zigzag", use_pallas=True)(
+                q[:, order], k[:, order], v[:, order])
+            for a, b in zip(g_c, g_z):
+                np.testing.assert_allclose(np.asarray(a),
+                                           np.asarray(b)[:, inv],
+                                           rtol=1e-4, atol=1e-5)
+            for a, b in zip(g_z, g_zp):
+                np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                           rtol=1e-4, atol=1e-5)
+        finally:
+            bf.shutdown()
+
+    def test_rejects_non_causal_and_odd_blocks(self, cpu_devices):
+        bf.init(devices=cpu_devices, nodes_per_machine=1)
+        try:
+            q = jnp.zeros((1, 48, 1, 4))
+            with pytest.raises(ValueError, match="causal"):
+                jax.shard_map(
+                    lambda a: ring_attention(a, a, a, axis="rank",
+                                             layout="zigzag"),
+                    mesh=bf.mesh(), in_specs=P(None, "rank"),
+                    out_specs=P(None, "rank"))(q)
+            # odd per-device block (40 tokens / 8 devices = 5)
+            q_odd = jnp.zeros((1, 40, 1, 4))
+            with pytest.raises(ValueError, match="even"):
+                jax.shard_map(
+                    lambda a: ring_attention(a, a, a, axis="rank",
+                                             causal=True, layout="zigzag"),
+                    mesh=bf.mesh(), in_specs=P(None, "rank"),
+                    out_specs=P(None, "rank"))(q_odd)
+            # mismatched k/v block length
+            k_short = jnp.zeros((1, 16, 1, 4))
+            with pytest.raises(ValueError, match="equal"):
+                jax.shard_map(
+                    lambda a, b: ring_attention(a, b, b, axis="rank",
+                                                causal=True, layout="zigzag"),
+                    mesh=bf.mesh(), in_specs=(P(None, "rank"),) * 2,
+                    out_specs=P(None, "rank"))(q, k_short)
+        finally:
+            bf.shutdown()

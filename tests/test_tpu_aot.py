@@ -365,3 +365,26 @@ def test_zero_lowering_is_reduce_scatter_all_gather(tpu_mesh):
     assert len(gathers) == 1, gathers
     lines = txt.splitlines()
     assert re.search(r"bf16\[32768\]", lines[gathers[0]])
+
+
+def test_zigzag_ring_lowers_with_conditional_skip(tpu_mesh):
+    """The balanced (zigzag) causal ring compiles for v5e: the three chunk-
+    pair partial sites lower through Mosaic, and the i>=s / s>=i visibility
+    predicates become real HLO conditionals — devices skip fully-masked
+    pairs at runtime instead of computing masked scores."""
+    B, T, H, D = 1, N * 256, 4, 64      # per-device block 256 = 2 chunks
+
+    def f(q, k, v):
+        return ring_attention(q, k, v, axis="rank", causal=True,
+                              layout="zigzag", use_pallas=True,
+                              pallas_block_q=128, pallas_interpret=False)
+
+    fn = jax.jit(jax.shard_map(
+        f, mesh=tpu_mesh, in_specs=(P(None, "rank"),) * 3,
+        out_specs=P(None, "rank")))
+    sds = tuple(jax.ShapeDtypeStruct(
+        (B, T, H, D), jnp.bfloat16,
+        sharding=NamedSharding(tpu_mesh, P(None, "rank"))) for _ in range(3))
+    txt = fn.lower(*sds).compile().as_text()
+    assert txt.count("tpu_custom_call") == 3     # lo x lo, hi x lo, hi x hi
+    assert "conditional" in txt                  # the visibility skips

@@ -25,6 +25,8 @@ class RingTransformerBlock(nn.Module):
     dtype: Any = jnp.bfloat16
     sp_mode: str = "ring"               # "ring" (K/V rotation) | "ulysses"
                                         # (head-scatter all_to_all)
+    sp_layout: str = "contiguous"       # "zigzag": balanced causal ring
+                                        # (sequence pre-permuted, ring only)
     use_pallas: bool = False            # VMEM flash kernel for the attention
     pallas_interpret: Optional[bool] = None   # override backend auto-detect
 
@@ -43,12 +45,21 @@ class RingTransformerBlock(nn.Module):
             raise ValueError(
                 f"unknown sp_mode {self.sp_mode!r}; choose 'ring' or "
                 "'ulysses'")
+        if self.sp_layout not in ("contiguous", "zigzag"):
+            raise ValueError(f"unknown sp_layout {self.sp_layout!r}")
+        if self.sp_layout == "zigzag" and self.sp_mode != "ring":
+            raise ValueError("sp_layout='zigzag' is a ring-attention layout")
         if self.axis is not None:
-            attn = (ring_attention if self.sp_mode == "ring"
-                    else ulysses_attention)
-            att = attn(q, k, v, axis=self.axis, causal=True,
-                       use_pallas=self.use_pallas,
-                       pallas_interpret=self.pallas_interpret)
+            if self.sp_mode == "ring":
+                att = ring_attention(
+                    q, k, v, axis=self.axis, causal=True,
+                    layout=self.sp_layout, use_pallas=self.use_pallas,
+                    pallas_interpret=self.pallas_interpret)
+            else:
+                att = ulysses_attention(
+                    q, k, v, axis=self.axis, causal=True,
+                    use_pallas=self.use_pallas,
+                    pallas_interpret=self.pallas_interpret)
         else:
             # single-device fallback: dense causal attention
             att = dense_attention(q, k, v, causal=True).astype(self.dtype)
@@ -77,17 +88,24 @@ class RingTransformerLM(nn.Module):
     axis: Optional[str] = None
     dtype: Any = jnp.bfloat16
     sp_mode: str = "ring"   # sequence-parallel mode: "ring" | "ulysses"
+    sp_layout: str = "contiguous"   # "zigzag": balanced causal ring
     remat: bool = False     # rematerialize blocks: trade FLOPs for HBM
     use_pallas: bool = False
     pallas_interpret: Optional[bool] = None
 
     @nn.compact
-    def __call__(self, tokens, pos_offset=0):
+    def __call__(self, tokens, pos_offset=0, positions=None):
+        """``positions`` ([T] int32 global positions) overrides the
+        contiguous ``pos_offset + arange`` — required for the zigzag
+        layout, where a device's tokens are two non-adjacent chunks
+        (:func:`bluefog_tpu.ops.zigzag_positions`)."""
         B, T = tokens.shape
         x = nn.Embed(self.vocab_size, self.d_model,
                      dtype=self.dtype)(tokens)
+        if positions is None:
+            positions = pos_offset + jnp.arange(T)
         pos = nn.Embed(self.max_seq_len, self.d_model, dtype=self.dtype)(
-            pos_offset + jnp.arange(T))
+            positions)
         x = x + pos[None]
         Block = (nn.remat(RingTransformerBlock,
                           policy=jax.checkpoint_policies.nothing_saveable)
@@ -95,7 +113,8 @@ class RingTransformerLM(nn.Module):
         for _ in range(self.num_layers):
             x = Block(
                 num_heads=self.num_heads, axis=self.axis, dtype=self.dtype,
-                sp_mode=self.sp_mode, use_pallas=self.use_pallas,
+                sp_mode=self.sp_mode, sp_layout=self.sp_layout,
+                use_pallas=self.use_pallas,
                 pallas_interpret=self.pallas_interpret)(x)
         x = nn.LayerNorm(dtype=jnp.float32)(x)
         return nn.Dense(self.vocab_size, use_bias=False,

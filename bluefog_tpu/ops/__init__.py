@@ -17,7 +17,7 @@ from .collectives import (
     hierarchical_neighbor_allreduce,
 )
 from .ring import (ring_pass, ring_allreduce, ring_attention,
-                   zigzag_order, zigzag_inverse)
+                   zigzag_order, zigzag_inverse, zigzag_positions)
 from .ulysses import ulysses_attention, local_flash_attention
 
 __all__ = [
@@ -35,6 +35,7 @@ __all__ = [
     "ring_attention",
     "zigzag_order",
     "zigzag_inverse",
+    "zigzag_positions",
     "ulysses_attention",
     "local_flash_attention",
 ]

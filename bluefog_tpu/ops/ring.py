@@ -142,6 +142,15 @@ def zigzag_inverse(n: int, total_len: int) -> np.ndarray:
     return np.argsort(zigzag_order(n, total_len))
 
 
+def zigzag_positions(idx, n: int, chunk: int) -> jax.Array:
+    """Global positions of device ``idx``'s zigzag tokens ([2*chunk] int32):
+    chunk ``idx`` followed by chunk ``2n-1-idx``.  For position embeddings /
+    RoPE inside shard_map (``idx`` may be a traced ``lax.axis_index``)."""
+    lo = idx * chunk + jnp.arange(chunk, dtype=jnp.int32)
+    hi = (2 * n - 1 - idx) * chunk + jnp.arange(chunk, dtype=jnp.int32)
+    return jnp.concatenate([lo, hi])
+
+
 @partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def _zigzag_pallas(q, k, v, axis: Axis, scale: float, block_q: int,
                    interpret: Optional[bool]):

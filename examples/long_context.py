@@ -35,6 +35,11 @@ def main():
                         choices=["ring", "ulysses"],
                         help="sequence-parallel mode: K/V ring rotation or "
                              "all-to-all head scatter (needs heads %% n == 0)")
+    parser.add_argument("--sp-layout", default="contiguous",
+                        choices=["contiguous", "zigzag"],
+                        help="zigzag: balanced causal ring (striped) — every "
+                             "device computes two half-chunks per step "
+                             "instead of the last device computing them all")
     parser.add_argument("--use-pallas", action="store_true",
                         help="VMEM flash kernel for attention fwd+bwd "
                              "(interpret mode off-TPU: slow, test-only)")
@@ -69,10 +74,14 @@ def main():
             f"--sp-mode ulysses needs --d-model divisible by the device "
             f"count ({n}); got {args.d_model}")
     heads = n if args.sp_mode == "ulysses" else 2
+    if args.sp_layout == "zigzag" and args.sp_mode != "ring":
+        raise SystemExit("--sp-layout zigzag goes with --sp-mode ring")
+    if args.sp_layout == "zigzag" and local_T % 2:
+        raise SystemExit("zigzag needs an even per-device block")
     lm = models.RingTransformerLM(
         vocab_size=vocab, num_layers=2, num_heads=heads, d_model=args.d_model,
         max_seq_len=T, axis="rank", dtype=jnp.float32, sp_mode=args.sp_mode,
-        use_pallas=args.use_pallas)
+        sp_layout=args.sp_layout, use_pallas=args.use_pallas)
     params = lm.clone(axis=None).init(
         jax.random.key(args.seed), jnp.zeros((1, local_T), jnp.int32))
 
@@ -81,9 +90,12 @@ def main():
 
     def step_fn(params, opt_state, tokens, targets):
         idx = jax.lax.axis_index("rank")
+        positions = (bf.ops.zigzag_positions(idx, n, local_T // 2)
+                     if args.sp_layout == "zigzag" else
+                     idx * local_T + jnp.arange(local_T))
 
         def loss_fn(p):
-            logits = lm.apply(p, tokens, pos_offset=idx * local_T)
+            logits = lm.apply(p, tokens, positions=positions)
             mask = (targets >= 0).astype(jnp.float32)
             ce = optax.softmax_cross_entropy_with_integer_labels(
                 logits, jnp.maximum(targets, 0))
@@ -106,22 +118,26 @@ def main():
         out_specs=(P(), P(), P()), check_vma=not interp_pallas))
 
     rng = np.random.default_rng(args.seed)
+    # zigzag: permute tokens AND targets into the balanced shard order
+    order = (bf.ops.zigzag_order(n, T) if args.sp_layout == "zigzag"
+             else np.arange(T))
     losses = []
     for it in range(args.steps):
         seq = rng.integers(0, vocab, size=(1, T))
         targets = np.full((1, T), -1, np.int64)
         targets[:, args.lag:] = seq[:, :-args.lag]     # predict token lag back
         params, opt_state, loss = train(
-            params, opt_state, jnp.asarray(seq, jnp.int32),
-            jnp.asarray(targets, jnp.int32))
+            params, opt_state, jnp.asarray(seq[:, order], jnp.int32),
+            jnp.asarray(targets[:, order], jnp.int32))
         losses.append(float(jax.block_until_ready(loss)))
         if it % 10 == 0 or it == args.steps - 1:
             print(f"step {it}: loss {losses[-1]:.4f} "
                   f"(seq {T} over {n} devices, {local_T}/device)")
 
     assert losses[-1] < losses[0], "no training progress through the ring"
-    print(f"[{args.sp_mode}-SP] loss {losses[0]:.3f} -> {losses[-1]:.3f} on "
-          f"{T}-token context sharded {n} ways")
+    layout_tag = "/zigzag" if args.sp_layout == "zigzag" else ""
+    print(f"[{args.sp_mode}-SP{layout_tag}] loss {losses[0]:.3f} -> "
+          f"{losses[-1]:.3f} on {T}-token context sharded {n} ways")
 
 
 if __name__ == "__main__":

@@ -196,3 +196,40 @@ class TestZigzag:
                     out_specs=P(None, "rank"))(q, k_short)
         finally:
             bf.shutdown()
+
+
+def test_zigzag_lm_matches_contiguous_lm(cpu_devices):
+    """Same params: the zigzag-layout LM's logits, un-permuted, equal the
+    contiguous LM's — layout is a re-shard of the same model/math."""
+    import bluefog_tpu.models as models
+    bf.init(devices=cpu_devices, nodes_per_machine=1)
+    try:
+        T = 8 * 4
+        lm_c = models.RingTransformerLM(
+            vocab_size=17, num_layers=1, num_heads=2, d_model=8,
+            max_seq_len=T, axis="rank", dtype=jnp.float32)
+        lm_z = lm_c.clone(sp_layout="zigzag")
+        local_T = T // N
+        params = lm_c.clone(axis=None).init(
+            jax.random.key(0), jnp.zeros((1, local_T), jnp.int32))
+        rng = np.random.default_rng(1)
+        tokens = rng.integers(0, 17, size=(1, T))
+
+        def run(lm, toks, zigzag):
+            def f(p, tk):
+                idx = jax.lax.axis_index("rank")
+                pos = (ops.zigzag_positions(idx, N, local_T // 2) if zigzag
+                       else idx * local_T + jnp.arange(local_T))
+                return lm.apply(p, tk, positions=pos)
+            fn = jax.jit(jax.shard_map(
+                f, mesh=bf.mesh(), in_specs=(P(), P(None, "rank")),
+                out_specs=P(None, "rank")))
+            return np.asarray(fn(params, jnp.asarray(toks, jnp.int32)))
+
+        out_c = run(lm_c, tokens, zigzag=False)
+        order = ops.zigzag_order(N, T)
+        inv = ops.zigzag_inverse(N, T)
+        out_z = run(lm_z, tokens[:, order], zigzag=True)[:, inv]
+        np.testing.assert_allclose(out_z, out_c, rtol=1e-4, atol=1e-5)
+    finally:
+        bf.shutdown()

@@ -17,6 +17,23 @@ from ..ops import ring_attention, ulysses_attention
 from ..ops.ulysses import dense_attention
 
 
+def apply_rope(x: jax.Array, positions: jax.Array,
+               base: float = 10000.0) -> jax.Array:
+    """Rotary position embedding on ``[B, T, H, D]`` with per-token global
+    ``positions`` ([T] int).  Rotation is per-token, so it commutes with any
+    sequence sharding — each device rotates its own q/k by its own global
+    positions and ring/zigzag/ulysses attention stays exact."""
+    d = x.shape[-1]
+    half = d // 2
+    freqs = base ** (-jnp.arange(half, dtype=jnp.float32) / half)
+    ang = positions.astype(jnp.float32)[:, None] * freqs[None]     # [T, half]
+    cos = jnp.cos(ang)[None, :, None, :]
+    sin = jnp.sin(ang)[None, :, None, :]
+    x1, x2 = x[..., :half].astype(jnp.float32), x[..., half:].astype(jnp.float32)
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], -1).astype(x.dtype)
+
+
 class RingTransformerBlock(nn.Module):
     """Pre-LN decoder block; attention is ring-parallel when ``axis`` is set."""
     num_heads: int
@@ -27,11 +44,12 @@ class RingTransformerBlock(nn.Module):
                                         # (head-scatter all_to_all)
     sp_layout: str = "contiguous"       # "zigzag": balanced causal ring
                                         # (sequence pre-permuted, ring only)
+    rope: bool = False                  # rotary positions on q/k
     use_pallas: bool = False            # VMEM flash kernel for the attention
     pallas_interpret: Optional[bool] = None   # override backend auto-detect
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, positions=None):
         # x: [batch, local_seq, d_model]
         B, T, C = x.shape
         H = self.num_heads
@@ -41,6 +59,11 @@ class RingTransformerBlock(nn.Module):
         q = q.reshape(B, T, H, C // H)
         k = k.reshape(B, T, H, C // H)
         v = v.reshape(B, T, H, C // H)
+        if self.rope:
+            if positions is None:
+                raise ValueError("rope needs the tokens' global positions")
+            q = apply_rope(q, positions)
+            k = apply_rope(k, positions)
         if self.sp_mode not in ("ring", "ulysses"):
             raise ValueError(
                 f"unknown sp_mode {self.sp_mode!r}; choose 'ring' or "
@@ -89,6 +112,7 @@ class RingTransformerLM(nn.Module):
     dtype: Any = jnp.bfloat16
     sp_mode: str = "ring"   # sequence-parallel mode: "ring" | "ulysses"
     sp_layout: str = "contiguous"   # "zigzag": balanced causal ring
+    rope: bool = False      # rotary positions instead of learned absolute
     remat: bool = False     # rematerialize blocks: trade FLOPs for HBM
     use_pallas: bool = False
     pallas_interpret: Optional[bool] = None
@@ -104,9 +128,10 @@ class RingTransformerLM(nn.Module):
                      dtype=self.dtype)(tokens)
         if positions is None:
             positions = pos_offset + jnp.arange(T)
-        pos = nn.Embed(self.max_seq_len, self.d_model, dtype=self.dtype)(
-            positions)
-        x = x + pos[None]
+        if not self.rope:
+            pos = nn.Embed(self.max_seq_len, self.d_model, dtype=self.dtype)(
+                positions)
+            x = x + pos[None]
         Block = (nn.remat(RingTransformerBlock,
                           policy=jax.checkpoint_policies.nothing_saveable)
                  if self.remat else RingTransformerBlock)
@@ -114,8 +139,8 @@ class RingTransformerLM(nn.Module):
             x = Block(
                 num_heads=self.num_heads, axis=self.axis, dtype=self.dtype,
                 sp_mode=self.sp_mode, sp_layout=self.sp_layout,
-                use_pallas=self.use_pallas,
-                pallas_interpret=self.pallas_interpret)(x)
+                rope=self.rope, use_pallas=self.use_pallas,
+                pallas_interpret=self.pallas_interpret)(x, positions)
         x = nn.LayerNorm(dtype=jnp.float32)(x)
         return nn.Dense(self.vocab_size, use_bias=False,
                         dtype=jnp.float32)(x)

@@ -40,6 +40,8 @@ def main():
                         help="zigzag: balanced causal ring (striped) — every "
                              "device computes two half-chunks per step "
                              "instead of the last device computing them all")
+    parser.add_argument("--rope", action="store_true",
+                        help="rotary positions instead of learned absolute")
     parser.add_argument("--use-pallas", action="store_true",
                         help="VMEM flash kernel for attention fwd+bwd "
                              "(interpret mode off-TPU: slow, test-only)")
@@ -81,7 +83,7 @@ def main():
     lm = models.RingTransformerLM(
         vocab_size=vocab, num_layers=2, num_heads=heads, d_model=args.d_model,
         max_seq_len=T, axis="rank", dtype=jnp.float32, sp_mode=args.sp_mode,
-        sp_layout=args.sp_layout, use_pallas=args.use_pallas)
+        sp_layout=args.sp_layout, rope=args.rope, use_pallas=args.use_pallas)
     params = lm.clone(axis=None).init(
         jax.random.key(args.seed), jnp.zeros((1, local_T), jnp.int32))
 

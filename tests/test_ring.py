@@ -233,3 +233,58 @@ def test_zigzag_lm_matches_contiguous_lm(cpu_devices):
         np.testing.assert_allclose(out_z, out_c, rtol=1e-4, atol=1e-5)
     finally:
         bf.shutdown()
+
+
+class TestRope:
+    def test_rope_scores_are_relative(self):
+        """q.k after rotary rotation depends only on the position GAP:
+        the same q/k pair at positions (5,3) and (105,103) score equally."""
+        from bluefog_tpu.models.transformer import apply_rope
+        rng = np.random.default_rng(0)
+        q = jnp.asarray(rng.normal(size=(1, 1, 1, 8)), jnp.float32)
+        k = jnp.asarray(rng.normal(size=(1, 1, 1, 8)), jnp.float32)
+
+        def score(qpos, kpos):
+            qr = apply_rope(q, jnp.asarray([qpos]))
+            kr = apply_rope(k, jnp.asarray([kpos]))
+            return float(jnp.sum(qr * kr))
+
+        np.testing.assert_allclose(score(5, 3), score(105, 103), rtol=1e-5)
+        np.testing.assert_allclose(score(7, 7), score(0, 0), rtol=1e-5)
+        assert abs(score(5, 3) - score(5, 4)) > 1e-6   # gap actually matters
+
+    def test_rope_lm_zigzag_matches_contiguous(self, cpu_devices):
+        """RoPE composes with sequence sharding: per-token rotation by
+        global position makes the zigzag and contiguous layouts identical."""
+        import bluefog_tpu.models as models
+        bf.init(devices=cpu_devices, nodes_per_machine=1)
+        try:
+            T = 8 * 4
+            lm_c = models.RingTransformerLM(
+                vocab_size=17, num_layers=1, num_heads=2, d_model=8,
+                max_seq_len=T, axis="rank", dtype=jnp.float32, rope=True)
+            lm_z = lm_c.clone(sp_layout="zigzag")
+            local_T = T // N
+            params = lm_c.clone(axis=None).init(
+                jax.random.key(0), jnp.zeros((1, local_T), jnp.int32))
+            rng = np.random.default_rng(1)
+            tokens = rng.integers(0, 17, size=(1, T))
+
+            def run(lm, toks, zigzag):
+                def f(p, tk):
+                    idx = jax.lax.axis_index("rank")
+                    pos = (ops.zigzag_positions(idx, N, local_T // 2)
+                           if zigzag else idx * local_T + jnp.arange(local_T))
+                    return lm.apply(p, tk, positions=pos)
+                fn = jax.jit(jax.shard_map(
+                    f, mesh=bf.mesh(), in_specs=(P(), P(None, "rank")),
+                    out_specs=P(None, "rank")))
+                return np.asarray(fn(params, jnp.asarray(toks, jnp.int32)))
+
+            out_c = run(lm_c, tokens, zigzag=False)
+            order = ops.zigzag_order(N, T)
+            inv = ops.zigzag_inverse(N, T)
+            out_z = run(lm_z, tokens[:, order], zigzag=True)[:, inv]
+            np.testing.assert_allclose(out_z, out_c, rtol=1e-4, atol=1e-5)
+        finally:
+            bf.shutdown()

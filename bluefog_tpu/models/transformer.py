@@ -37,6 +37,8 @@ def apply_rope(x: jax.Array, positions: jax.Array,
 class RingTransformerBlock(nn.Module):
     """Pre-LN decoder block; attention is ring-parallel when ``axis`` is set."""
     num_heads: int
+    num_kv_heads: Optional[int] = None  # grouped-query attention (ring only):
+                                        # compact kv — G x fewer ring bytes
     mlp_ratio: int = 4
     axis: Optional[str] = None          # mesh axis the sequence is sharded over
     dtype: Any = jnp.bfloat16
@@ -54,11 +56,23 @@ class RingTransformerBlock(nn.Module):
         B, T, C = x.shape
         H = self.num_heads
         h = nn.LayerNorm(dtype=jnp.float32)(x).astype(self.dtype)
-        qkv = nn.Dense(3 * C, use_bias=False, dtype=self.dtype)(h)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-        q = q.reshape(B, T, H, C // H)
-        k = k.reshape(B, T, H, C // H)
-        v = v.reshape(B, T, H, C // H)
+        Hkv = self.num_kv_heads or H
+        Dh = C // H
+        if Hkv == H:
+            qkv = nn.Dense(3 * C, use_bias=False, dtype=self.dtype)(h)
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+        else:
+            if H % Hkv:
+                raise ValueError(
+                    f"num_heads {H} not a multiple of num_kv_heads {Hkv}")
+            qkv = nn.Dense(C + 2 * Hkv * Dh, use_bias=False,
+                           dtype=self.dtype)(h)
+            q = qkv[..., :C]
+            k = qkv[..., C:C + Hkv * Dh]
+            v = qkv[..., C + Hkv * Dh:]
+        q = q.reshape(B, T, H, Dh)
+        k = k.reshape(B, T, Hkv, Dh)
+        v = v.reshape(B, T, Hkv, Dh)
         if self.rope:
             if positions is None:
                 raise ValueError("rope needs the tokens' global positions")
@@ -84,7 +98,10 @@ class RingTransformerBlock(nn.Module):
                     use_pallas=self.use_pallas,
                     pallas_interpret=self.pallas_interpret)
         else:
-            # single-device fallback: dense causal attention
+            # single-device fallback: dense causal attention (expand GQA kv)
+            if Hkv != H:
+                k = jnp.repeat(k, H // Hkv, axis=2)
+                v = jnp.repeat(v, H // Hkv, axis=2)
             att = dense_attention(q, k, v, causal=True).astype(self.dtype)
         att = att.reshape(B, T, C)
         x = x + nn.Dense(C, use_bias=False, dtype=self.dtype)(att)
@@ -106,6 +123,7 @@ class RingTransformerLM(nn.Module):
     vocab_size: int = 32000
     num_layers: int = 4
     num_heads: int = 8
+    num_kv_heads: Optional[int] = None   # GQA (ring sp_mode only)
     d_model: int = 512
     max_seq_len: int = 8192
     axis: Optional[str] = None
@@ -137,7 +155,8 @@ class RingTransformerLM(nn.Module):
                  if self.remat else RingTransformerBlock)
         for _ in range(self.num_layers):
             x = Block(
-                num_heads=self.num_heads, axis=self.axis, dtype=self.dtype,
+                num_heads=self.num_heads, num_kv_heads=self.num_kv_heads,
+                axis=self.axis, dtype=self.dtype,
                 sp_mode=self.sp_mode, sp_layout=self.sp_layout,
                 rope=self.rope, use_pallas=self.use_pallas,
                 pallas_interpret=self.pallas_interpret)(x, positions)

@@ -67,8 +67,17 @@ def _q_spec(t: int, d: int) -> pl.BlockSpec:
     return pl.BlockSpec((1, t, d), lambda i, j: (i, j, 0))
 
 
-def _kv_spec(t: int, d: int) -> pl.BlockSpec:
-    return pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0))
+def _kv_spec(t: int, d: int, H: int = 0, Hkv: int = 0) -> pl.BlockSpec:
+    """K/V block for grid step i over B*H (q-head-major) grid steps.
+
+    With grouped-query attention (``Hkv < H``) the K/V array stays compact
+    at ``[B*Hkv, T, D]`` and the index map routes q head ``h`` to kv head
+    ``h // (H // Hkv)`` — GQA costs zero data expansion in the kernel."""
+    if not H or H == Hkv:
+        return pl.BlockSpec((1, t, d), lambda i, j: (i, 0, 0))
+    group = H // Hkv
+    return pl.BlockSpec(
+        (1, t, d), lambda i, j: ((i // H) * Hkv + (i % H) // group, 0, 0))
 
 
 def _smem_scalar(x: jax.Array) -> jax.Array:
@@ -118,8 +127,8 @@ def _partial_kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref,
     jax.jit, static_argnames=("causal", "scale", "interpret", "block_q"))
 def attention_block_partial(
     q: jax.Array,                  # [B, Tq, H, D]
-    k: jax.Array,                  # [B, Tk, H, D]
-    v: jax.Array,                  # [B, Tk, H, D]
+    k: jax.Array,                  # [B, Tk, Hkv, D] — Hkv may divide H (GQA)
+    v: jax.Array,                  # [B, Tk, Hkv, D]
     q_offset: jax.Array,           # [] int32 — global position of q[0]
     k_offset: jax.Array,           # [] int32
     *,
@@ -135,7 +144,9 @@ def attention_block_partial(
     ``m = -inf, l = 0, o = 0``).
     """
     B, Tq, H, D = q.shape
-    Tk = k.shape[1]
+    Tk, Hkv = k.shape[1], k.shape[2]
+    if H % Hkv:
+        raise ValueError(f"q heads {H} not a multiple of kv heads {Hkv}")
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
 
@@ -153,8 +164,8 @@ def attention_block_partial(
             pl.BlockSpec(memory_space=pltpu.SMEM),   # scalar offsets
             pl.BlockSpec(memory_space=pltpu.SMEM),
             _q_spec(qb, D),
-            _kv_spec(Tk, D),
-            _kv_spec(Tk, D),
+            _kv_spec(Tk, D, H, Hkv),
+            _kv_spec(Tk, D, H, Hkv),
         ],
         out_specs=[
             _q_spec(qb, D),
@@ -177,7 +188,8 @@ def attention_block_partial(
 
 def _backward_kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref, do_ref,
                      lse_ref, delta_ref, dq_ref, dk_ref, dv_ref, *,
-                     causal: bool, scale: float, block_q: int):
+                     causal: bool, scale: float, block_q: int,
+                     num_heads: int = 0, group: int = 1):
     """Flash-attention backward for one K/V block, scores recomputed in VMEM.
 
     Standard FlashAttention-2 backward recurrence with the *global* softmax
@@ -225,12 +237,19 @@ def _backward_kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref, do_ref,
 
     dq_ref[0] = dq
 
-    @pl.when(j == 0)
+    # dk/dv accumulate across the (sequential) grid: over q blocks (j) and,
+    # under GQA, over the q heads sharing this kv head — initialize only on
+    # the FIRST (head-in-group, q-block) step touching the block
+    i = pl.program_id(0)
+    first = (j == 0) if group == 1 else (
+        (j == 0) & (jax.lax.rem(jax.lax.rem(i, num_heads), group) == 0))
+
+    @pl.when(first)
     def _():
         dk_ref[0] = dk
         dv_ref[0] = dv
 
-    @pl.when(j != 0)
+    @pl.when(jnp.logical_not(first))
     def _():
         dk_ref[0] += dk
         dv_ref[0] += dv
@@ -240,8 +259,8 @@ def _backward_kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref, do_ref,
     jax.jit, static_argnames=("causal", "scale", "interpret", "block_q"))
 def attention_block_backward(
     q: jax.Array,                  # [B, Tq, H, D]
-    k: jax.Array,                  # [B, Tk, H, D]
-    v: jax.Array,                  # [B, Tk, H, D]
+    k: jax.Array,                  # [B, Tk, Hkv, D] — Hkv may divide H (GQA)
+    v: jax.Array,                  # [B, Tk, Hkv, D]
     do: jax.Array,                 # [B, Tq, H, D] — cotangent of the output
     lse: jax.Array,                # [B, Tq, H] f32 — global log-sum-exp
     delta: jax.Array,              # [B, Tq, H] f32 — rowsum(do * o)
@@ -260,7 +279,10 @@ def attention_block_backward(
     w.r.t. this device's queries (sum over devices as the block rotates).
     """
     B, Tq, H, D = q.shape
-    Tk = k.shape[1]
+    Tk, Hkv = k.shape[1], k.shape[2]
+    if H % Hkv:
+        raise ValueError(f"q heads {H} not a multiple of kv heads {Hkv}")
+    group = H // Hkv
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
 
@@ -274,7 +296,7 @@ def attention_block_backward(
     deltar = _pad_rows(_split_heads(delta.astype(jnp.float32)[..., None]), pad)
 
     kernel = functools.partial(_backward_kernel, causal=causal, scale=scale,
-                               block_q=qb)
+                               block_q=qb, num_heads=H, group=group)
     vma = _vma_of(qr)
     dq, dk, dv = pl.pallas_call(
         kernel,
@@ -283,29 +305,29 @@ def attention_block_backward(
             pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec(memory_space=pltpu.SMEM),
             _q_spec(qb, D),
-            _kv_spec(Tk, D),
-            _kv_spec(Tk, D),
+            _kv_spec(Tk, D, H, Hkv),
+            _kv_spec(Tk, D, H, Hkv),
             _q_spec(qb, D),
             _q_spec(qb, 1),
             _q_spec(qb, 1),
         ],
         out_specs=[
             _q_spec(qb, D),
-            _kv_spec(Tk, D),
-            _kv_spec(Tk, D),
+            _kv_spec(Tk, D, H, Hkv),
+            _kv_spec(Tk, D, H, Hkv),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B * H, Tp, D), jnp.float32, vma=vma),
-            jax.ShapeDtypeStruct((B * H, Tk, D), jnp.float32, vma=vma),
-            jax.ShapeDtypeStruct((B * H, Tk, D), jnp.float32, vma=vma),
+            jax.ShapeDtypeStruct((B * Hkv, Tk, D), jnp.float32, vma=vma),
+            jax.ShapeDtypeStruct((B * Hkv, Tk, D), jnp.float32, vma=vma),
         ],
         interpret=interpret,
     )(_smem_scalar(q_offset), _smem_scalar(k_offset),
       qr, kr, vr, dor, lser, deltar)
 
     dq = _merge_heads(dq[:, :Tq], B, H)
-    dk = _merge_heads(dk, B, H)
-    dv = _merge_heads(dv, B, H)
+    dk = _merge_heads(dk, B, Hkv)
+    dv = _merge_heads(dv, B, Hkv)
     return dq, dk, dv
 
 

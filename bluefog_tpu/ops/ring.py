@@ -93,6 +93,10 @@ def ring_attention(
     """
     if q.ndim != 4:
         raise ValueError("expected [batch, block_len, heads, head_dim]")
+    if q.shape[2] % k.shape[2] or k.shape[2] != v.shape[2]:
+        raise ValueError(
+            f"q heads {q.shape[2]} must be a multiple of kv heads "
+            f"{k.shape[2]} (grouped-query attention), with k/v matching")
     d = q.shape[-1]
     if scale is None:
         scale = 1.0 / np.sqrt(d)
@@ -213,6 +217,10 @@ def _zigzag_impl(q, k, v, axis: Axis, scale: float,
             return pa.attention_block_partial(
                 qc, kc, vc, q_off, k_off, causal=masked, scale=scale,
                 block_q=block_q, interpret=interpret)
+        G = qc.shape[2] // kc.shape[2]
+        if G > 1:                    # GQA: broadcast compact kv at the einsum
+            kc = jnp.repeat(kc, G, axis=2)
+            vc = jnp.repeat(vc, G, axis=2)
         qf = qc.astype(jnp.float32) * scale
         s = jnp.einsum("bihd,bjhd->bihj", qf, kc.astype(jnp.float32))
         if masked:
@@ -398,16 +406,24 @@ def _jnp_ring_attention(q, k, v, axis: Axis, causal: bool, scale: float):
 
     q_pos = idx * blk_q + jnp.arange(blk_q)                      # global positions
 
+    G = q.shape[2] // k.shape[2]     # GQA group (1 = standard MHA)
+
     def step(carry, t):
         o, l, m, kt, vt = carry
         src = (idx - t) % n                                      # owner of current kv block
+        # GQA: the ring rotates the COMPACT kv (G x fewer permute bytes).
+        # jnp.repeat materializes the expanded block per step — acceptable
+        # on this fallback path; the pallas kernel path expands nothing
+        # (BlockSpec index map routes q heads to their kv head)
+        kte = jnp.repeat(kt, G, axis=2) if G > 1 else kt
+        vte = jnp.repeat(vt, G, axis=2) if G > 1 else vt
         # scores[b, i, h, j] = qf[b,i,h,:] . kt[b,j,h,:]
-        s = jnp.einsum("bihd,bjhd->bihj", qf, kt.astype(jnp.float32))
+        s = jnp.einsum("bihd,bjhd->bihj", qf, kte.astype(jnp.float32))
         if causal:
             k_pos = src * blk_k + jnp.arange(blk_k)
             mask = q_pos[:, None, None] >= k_pos[None, None, :]  # [Tq, 1, Tk]
             s = jnp.where(mask[None], s, -jnp.inf)
-        o, l, m_new = online_softmax_merge(o, l, m, s, vt)
+        o, l, m_new = online_softmax_merge(o, l, m, s, vte)
         kt = lax.ppermute(kt, axis, perm=perm)
         vt = lax.ppermute(vt, axis, perm=perm)
         return (o, l, m_new, kt, vt), None

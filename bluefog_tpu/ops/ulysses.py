@@ -182,6 +182,11 @@ def ulysses_attention(
     """
     if q.ndim != 4:
         raise ValueError("expected [batch, block_len, heads, head_dim]")
+    if k.shape[2] != q.shape[2] or v.shape[2] != q.shape[2]:
+        raise ValueError(
+            "ulysses scatters heads across the axis and needs equal q/kv "
+            "head counts; grouped-query (GQA) kv is a ring_attention "
+            "feature")
     n = lax.axis_size(axis)
     H = q.shape[2]
     if H % n:

@@ -276,3 +276,91 @@ def test_pallas_bwd_bf16(cpu_devices):
                 rtol=0.1, atol=0.05)
     finally:
         bf.shutdown()
+
+
+class TestGQA:
+    """Grouped-query attention: compact [B, T, Hkv, D] k/v, q heads grouped
+    onto kv heads via the kernel's BlockSpec index map (zero data expansion)."""
+
+    def _expand(self, kv, G):
+        return jnp.repeat(kv, G, axis=2)
+
+    def test_forward_partial_matches_expanded(self):
+        rng = np.random.default_rng(20)
+        B, T, H, Hkv, D = 2, 16, 4, 2, 8
+        q = jnp.asarray(rng.normal(size=(B, T, H, D)), jnp.float32)
+        k = jnp.asarray(rng.normal(size=(B, T, Hkv, D)), jnp.float32)
+        v = jnp.asarray(rng.normal(size=(B, T, Hkv, D)), jnp.float32)
+        gqa = pa.attention_block_partial(
+            q, k, v, jnp.asarray(4), jnp.asarray(0), causal=True,
+            scale=0.4, interpret=True)
+        full = pa.attention_block_partial(
+            q, self._expand(k, 2), self._expand(v, 2), jnp.asarray(4),
+            jnp.asarray(0), causal=True, scale=0.4, interpret=True)
+        for a, b in zip(gqa, full):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-6, atol=1e-6)
+
+    def test_backward_matches_expanded(self):
+        """GQA dk/dv equal the head-group SUM of the expanded grads (the
+        chain rule through the implicit broadcast)."""
+        rng = np.random.default_rng(21)
+        B, T, H, Hkv, D = 1, 16, 4, 2, 8
+        G = H // Hkv
+        scale = 1.0 / np.sqrt(D)
+        q = jnp.asarray(rng.normal(size=(B, T, H, D)), jnp.float32)
+        k = jnp.asarray(rng.normal(size=(B, T, Hkv, D)), jnp.float32)
+        v = jnp.asarray(rng.normal(size=(B, T, Hkv, D)), jnp.float32)
+        ke, ve = self._expand(k, G), self._expand(v, G)
+
+        out, (dq_e, dk_e, dv_e) = _dense_grads(q, ke, ve, True, scale)
+        do = 2.0 * out
+        _, l, m = pa.attention_block_partial(
+            q, k, v, jnp.asarray(0), jnp.asarray(0), causal=True,
+            scale=scale, interpret=True)
+        lse = jnp.where(l == 0.0, -jnp.inf,
+                        m + jnp.log(jnp.where(l == 0, 1, l)))
+        delta = jnp.sum(do * out, axis=-1)
+        dq, dk, dv = pa.attention_block_backward(
+            q, k, v, do, lse, delta, jnp.asarray(0), jnp.asarray(0),
+            causal=True, scale=scale, interpret=True, block_q=8)
+        np.testing.assert_allclose(np.asarray(dq), np.asarray(dq_e),
+                                   rtol=1e-4, atol=1e-5)
+        # expanded grads fold back: sum over each head group
+        dk_fold = np.asarray(dk_e).reshape(B, T, Hkv, G, D).sum(axis=3)
+        dv_fold = np.asarray(dv_e).reshape(B, T, Hkv, G, D).sum(axis=3)
+        np.testing.assert_allclose(np.asarray(dk), dk_fold,
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(dv), dv_fold,
+                                   rtol=1e-4, atol=1e-5)
+
+    @pytest.mark.parametrize("use_pallas", [False, True])
+    @pytest.mark.parametrize("layout", ["contiguous", "zigzag"])
+    def test_ring_gqa_matches_dense(self, cpu_devices, use_pallas, layout):
+        bf.init(devices=cpu_devices, nodes_per_machine=1)
+        try:
+            from bluefog_tpu.ops import zigzag_order, zigzag_inverse
+            rng = np.random.default_rng(22)
+            B, T, H, Hkv, D = 1, N * 4, 4, 2, 4
+            q = jnp.asarray(rng.normal(size=(B, T, H, D)), jnp.float32)
+            k = jnp.asarray(rng.normal(size=(B, T, Hkv, D)), jnp.float32)
+            v = jnp.asarray(rng.normal(size=(B, T, Hkv, D)), jnp.float32)
+
+            def f(qb, kb, vb):
+                return ring_attention(qb, kb, vb, axis="rank", causal=True,
+                                      layout=layout, use_pallas=use_pallas)
+
+            fn = jax.jit(jax.shard_map(
+                f, mesh=bf.mesh(), in_specs=(P(None, "rank"),) * 3,
+                out_specs=P(None, "rank"), check_vma=not use_pallas))
+            if layout == "zigzag":
+                order = zigzag_order(N, T)
+                out = np.asarray(fn(q[:, order], k[:, order], v[:, order]))
+                out = out[:, zigzag_inverse(N, T)]
+            else:
+                out = np.asarray(fn(q, k, v))
+            expected = dense_attention(
+                q, self._expand(k, 2), self._expand(v, 2), causal=True)
+            np.testing.assert_allclose(out, expected, rtol=1e-4, atol=1e-5)
+        finally:
+            bf.shutdown()

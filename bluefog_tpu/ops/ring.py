@@ -158,25 +158,90 @@ def zigzag_positions(idx, n: int, chunk: int) -> jax.Array:
 @partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def _zigzag_pallas(q, k, v, axis: Axis, scale: float, block_q: int,
                    interpret: Optional[bool]):
-    """Zigzag forward through the Pallas partials; backward recomputes
-    through the jnp formulation (the flash recurrence keeps the forward's
-    memory profile; the backward trades one extra scores materialization
-    per C x C chunk pair for kernel simplicity — a dedicated zigzag
-    backward kernel is a further optimization, not a correctness need)."""
+    """Zigzag forward AND backward through the Pallas kernels.
+
+    The forward saves ``(q, k, v, out, lse)``; the backward runs its own
+    balanced ring with the flash backward kernel per visible chunk pair —
+    like the contiguous ``_pallas_ring_bwd``, the compact dk/dv accumulators
+    rotate *with* the K/V blocks and arrive home fully reduced.  No
+    ``[C, Tk]`` score matrix exists in HBM in either direction."""
     return _zigzag_impl(q, k, v, axis, scale, True, block_q, interpret)
 
 
 def _zigzag_pallas_fwd(q, k, v, axis, scale, block_q, interpret):
-    out = _zigzag_impl(q, k, v, axis, scale, True, block_q, interpret)
-    return out, (q, k, v)
+    out, lse = _zigzag_impl(q, k, v, axis, scale, True, block_q, interpret,
+                            return_lse=True)
+    return out, (q, k, v, out, lse)
 
 
 def _zigzag_pallas_bwd(axis, scale, block_q, interpret, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: _zigzag_impl(q_, k_, v_, axis, scale,
-                                        False, 0, None), q, k, v)
-    return vjp(g)
+    from . import pallas_attention as pa
+
+    q, k, v, out, lse = res
+    n = lax.axis_size(axis)
+    idx = lax.axis_index(axis)
+    C = q.shape[1] // 2
+    perm = _ring_perm(n, 1)
+
+    do = g.astype(jnp.float32)
+    delta = jnp.sum(do * out.astype(jnp.float32), axis=-1)   # [B, 2C, H]
+    q_lo, q_hi = q[:, :C], q[:, C:]
+    do_lo, do_hi = do[:, :C], do[:, C:]
+    lse_lo, lse_hi = lse[:, :C], lse[:, C:]
+    dl_lo, dl_hi = delta[:, :C], delta[:, C:]
+    off_lo = idx * C
+    off_hi = (2 * n - 1 - idx) * C
+
+    def _bwd_combo(qc, kc, vc, do_c, lse_c, dl_c, q_off, k_off, masked):
+        return pa.attention_block_backward(
+            qc, kc, vc, do_c, lse_c, dl_c, q_off, k_off,
+            causal=masked, scale=scale, block_q=block_q, interpret=interpret)
+
+    def _bwd_if(pred, acc, qc, kc, vc, do_c, lse_c, dl_c, q_off, k_off):
+        def do_fn(a):
+            dq_c, dk_c, dv_c = a
+            dq_p, dk_p, dv_p = _bwd_combo(qc, kc, vc, do_c, lse_c, dl_c,
+                                          q_off, k_off, True)
+            return dq_c + dq_p, dk_c + dk_p, dv_c + dv_p
+        return lax.cond(pred, do_fn, lambda a: a, acc)
+
+    zero = lambda x: lax.pcast(jnp.zeros(x.shape, jnp.float32), axis,
+                               to='varying')
+    dq0_lo, dq0_hi = zero(q_lo), zero(q_hi)
+    dk0, dv0 = zero(k), zero(v)          # compact (GQA) accumulators
+
+    def bstep(carry, t):
+        dq_lo, dq_hi, kt, vt, dkt, dvt = carry
+        src = (idx - t) % n
+        k_lo, k_hi = kt[:, :C], kt[:, C:]
+        v_lo, v_hi = vt[:, :C], vt[:, C:]
+        dk_lo, dk_hi = dkt[:, :C], dkt[:, C:]
+        dv_lo, dv_hi = dvt[:, :C], dvt[:, C:]
+        koff_lo = src * C
+        koff_hi = (2 * n - 1 - src) * C
+        dq_lo, dk_lo, dv_lo = _bwd_if(
+            idx >= src, (dq_lo, dk_lo, dv_lo), q_lo, k_lo, v_lo,
+            do_lo, lse_lo, dl_lo, off_lo, koff_lo)
+        dq_p, dk_p, dv_p = _bwd_combo(            # always visible, mask-free
+            q_hi, k_lo, v_lo, do_hi, lse_hi, dl_hi, off_hi, koff_lo, False)
+        dq_hi = dq_hi + dq_p
+        dk_lo = dk_lo + dk_p
+        dv_lo = dv_lo + dv_p
+        dq_hi, dk_hi, dv_hi = _bwd_if(
+            src >= idx, (dq_hi, dk_hi, dv_hi), q_hi, k_hi, v_hi,
+            do_hi, lse_hi, dl_hi, off_hi, koff_hi)
+        dkt = jnp.concatenate([dk_lo, dk_hi], axis=1)
+        dvt = jnp.concatenate([dv_lo, dv_hi], axis=1)
+        kt = lax.ppermute(kt, axis, perm=perm)
+        vt = lax.ppermute(vt, axis, perm=perm)
+        dkt = lax.ppermute(dkt, axis, perm=perm)
+        dvt = lax.ppermute(dvt, axis, perm=perm)
+        return (dq_lo, dq_hi, kt, vt, dkt, dvt), None
+
+    (dq_lo, dq_hi, _, _, dk, dv), _ = lax.scan(
+        bstep, (dq0_lo, dq0_hi, k, v, dk0, dv0), jnp.arange(n))
+    dq = jnp.concatenate([dq_lo, dq_hi], axis=1)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
 _zigzag_pallas.defvjp(_zigzag_pallas_fwd, _zigzag_pallas_bwd)
@@ -184,7 +249,7 @@ _zigzag_pallas.defvjp(_zigzag_pallas_fwd, _zigzag_pallas_bwd)
 
 def _zigzag_impl(q, k, v, axis: Axis, scale: float,
                  use_pallas: bool, block_q: int,
-                 interpret: Optional[bool]):
+                 interpret: Optional[bool], return_lse: bool = False):
     """Balanced causal ring attention over the zigzag shard.
 
     Device i's local block is ``[chunk_lo = i, chunk_hi = 2n-1-i]`` (C rows
@@ -198,8 +263,8 @@ def _zigzag_impl(q, k, v, axis: Axis, scale: float,
 
     so every device computes exactly 2 C x C partials per step (3 at t=0)
     — balanced, where the contiguous layout loads the last device with
-    every block.  Grads flow by autodiff through the scan/cond (the pallas
-    partial has its own recompute rule via the flash recurrence).
+    every block.  jnp-path grads flow by autodiff through the scan/cond;
+    the pallas path has a dedicated kernel backward (_zigzag_pallas_bwd).
     """
     from . import pallas_attention as pa
 
@@ -275,7 +340,16 @@ def _zigzag_impl(q, k, v, axis: Axis, scale: float,
         o, l, m = olm
         return o / jnp.where(l == 0.0, 1.0, l)[..., None]
 
-    return jnp.concatenate([_norm(lo), _norm(hi)], axis=1).astype(q.dtype)
+    out = jnp.concatenate([_norm(lo), _norm(hi)], axis=1).astype(q.dtype)
+    if not return_lse:
+        return out
+
+    def _lse(olm):
+        _, l, m = olm
+        return jnp.where(l == 0.0, -jnp.inf,
+                         m + jnp.log(jnp.where(l == 0.0, 1.0, l)))
+
+    return out, jnp.concatenate([_lse(lo), _lse(hi)], axis=1)
 
 
 def _pallas_forward(q, k, v, axis: Axis, causal: bool, scale: float,

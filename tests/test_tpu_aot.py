@@ -388,3 +388,26 @@ def test_zigzag_ring_lowers_with_conditional_skip(tpu_mesh):
     txt = fn.lower(*sds).compile().as_text()
     assert txt.count("tpu_custom_call") == 3     # lo x lo, hi x lo, hi x hi
     assert "conditional" in txt                  # the visibility skips
+
+
+def test_zigzag_backward_lowers_through_mosaic(tpu_mesh):
+    """grad(zigzag+pallas) compiles for v5e through the dedicated kernel
+    backward: 3 forward + 3 backward Mosaic call sites, no dense [C, Tk]
+    score matmul in HBM in either direction."""
+    B, T, H, D = 1, N * 256, 4, 64
+
+    def loss(q, k, v):
+        out = ring_attention(q, k, v, axis="rank", causal=True,
+                             layout="zigzag", use_pallas=True,
+                             pallas_block_q=128, pallas_interpret=False)
+        return jax.lax.psum(jnp.sum(out.astype(jnp.float32) ** 2), "rank")
+
+    g = jax.value_and_grad(loss, argnums=(0, 1, 2))
+    fn = jax.jit(jax.shard_map(
+        g, mesh=tpu_mesh, in_specs=(P(None, "rank"),) * 3,
+        out_specs=(P(), (P(None, "rank"),) * 3)))
+    sds = tuple(jax.ShapeDtypeStruct(
+        (B, T, H, D), jnp.bfloat16,
+        sharding=NamedSharding(tpu_mesh, P(None, "rank"))) for _ in range(3))
+    txt = fn.lower(*sds).compile().as_text()
+    assert txt.count("tpu_custom_call") == 6

@@ -22,7 +22,8 @@ from typing import Any, Optional, Tuple
 
 import jax
 
-__all__ = ["save", "restore", "restore_latest", "latest_step"]
+__all__ = ["save", "restore", "restore_latest", "latest_step",
+           "resize_distributed"]
 
 _STEP_DIR = re.compile(r"^step_(\d+)$")
 
@@ -95,6 +96,56 @@ def restore_latest(
     if step is None:
         return None, None
     return restore(os.path.join(directory, f"step_{step}"), template), step
+
+
+def resize_distributed(state: Any, new_size: int, *, mode: str = "slice") -> Any:
+    """Re-target a distributed pytree (leading rank axis) to a new world size.
+
+    The elastic-restart primitive the reference lacks (SURVEY.md §5
+    "failure detection / elastic recovery: minimal"): a checkpoint taken on
+    n ranks resumes on m ranks.  Modes:
+
+    - ``"slice"``  — shrink keeps the first m ranks' (still decentralized)
+      states; grow gives rank r the state of rank ``r % n`` — survivors
+      keep their local trajectories, gossip re-mixes the rest.
+    - ``"mean"``   — consensus-collapse across the old rank axis, then
+      replicate: every new rank starts from the average (the clean-restart
+      semantic; matches the reference's broadcast_parameters flow).
+    - ``"rank0"``  — replicate rank 0's state (exactly the reference's
+      ``broadcast_parameters`` restart, ``torch/utility.py:26``).
+
+    Works on any pytree whose every leaf has the leading rank axis (params
+    and elementwise optimizer state).  Strategy state whose SHAPE depends on
+    the world size (ZeRO shards, window mailboxes, schedules) must be
+    re-initialized on the new mesh instead — pass resized params to
+    ``optimizers.init_distributed`` for a fresh state.
+    """
+    import numpy as np
+
+    if mode not in ("slice", "mean", "rank0"):
+        raise ValueError(f"unknown resize mode {mode!r}")
+
+    def leaf(x):
+        # resize on the HOST: restored arrays carry the old mesh's sharding,
+        # which would poison programs compiled for the new (smaller) mesh —
+        # numpy output lets the next step place them fresh
+        dt = x.dtype
+        x = np.asarray(jax.device_get(x))
+        if x.ndim == 0:            # global scalars (step counters) pass through
+            return x
+        n = x.shape[0]
+        if mode == "mean":
+            # integers/bools (counters, masks) have no meaningful mean; note
+            # kind-based check because ml_dtypes (bfloat16) is not np.inexact
+            discrete = x.dtype.kind in "iub"
+            core = x[0] if discrete else x.astype(np.float32).mean(
+                axis=0).astype(dt)
+            return np.broadcast_to(core[None], (new_size,) + x.shape[1:]).copy()
+        if mode == "rank0":
+            return np.broadcast_to(x[:1], (new_size,) + x.shape[1:]).copy()
+        return x[np.arange(new_size) % n]
+
+    return jax.tree.map(leaf, state)
 
 
 def _rmtree(path: str) -> None:

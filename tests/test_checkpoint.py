@@ -90,3 +90,70 @@ def test_keep_zero_rejected(tmp_path):
     tree = {"x": jnp.zeros((N, 2))}
     with pytest.raises(ValueError, match="keep"):
         ckpt.save(str(tmp_path), tree, step=1, keep=0)
+
+
+class TestElasticResize:
+    def test_modes(self):
+        x = jnp.arange(8.0)[:, None] * jnp.ones((8, 3))
+        tree = {"w": x, "step": jnp.asarray(5, jnp.int32)}
+        sl = ckpt.resize_distributed(tree, 4, mode="slice")
+        np.testing.assert_array_equal(np.asarray(sl["w"])[:, 0], [0, 1, 2, 3])
+        assert int(sl["step"]) == 5
+        gr = ckpt.resize_distributed(tree, 12, mode="slice")
+        np.testing.assert_array_equal(
+            np.asarray(gr["w"])[:, 0], list(range(8)) + [0, 1, 2, 3])
+        me = ckpt.resize_distributed(tree, 4, mode="mean")
+        np.testing.assert_allclose(np.asarray(me["w"]), 3.5)
+        r0 = ckpt.resize_distributed(tree, 4, mode="rank0")
+        np.testing.assert_allclose(np.asarray(r0["w"]), 0.0)
+        with pytest.raises(ValueError, match="mode"):
+            ckpt.resize_distributed(tree, 4, mode="median")
+
+    def test_elastic_resume_8_to_4(self, tmp_path, cpu_devices):
+        """Train on 8 ranks, checkpoint, resume on 4 (half the cluster
+        'lost'): survivors keep their trajectories (slice mode), strategy
+        state re-initializes on the new mesh, and training keeps
+        converging toward the target."""
+        target_val = 2.0
+
+        def grad_fn(params, batch):
+            return jax.value_and_grad(
+                lambda p: jnp.mean((p["x"] - batch) ** 2))(params)
+
+        def make(n):
+            strategy = bfopt.adapt_with_combine(
+                optax.sgd(0.2),
+                bfopt.neighbor_communicator(bf.static_schedule()))
+            return strategy, bfopt.make_train_step(grad_fn, strategy)
+
+        # phase 1: 8 ranks (module fixture ctx is already up)
+        strategy, step = make(8)
+        params = {"x": jnp.asarray(
+            np.random.default_rng(2).normal(size=(8, 1, 5)), jnp.float32)}
+        state = bfopt.init_distributed(strategy, params)
+        tgt8 = jnp.ones((8, 1, 5)) * target_val
+        for _ in range(5):
+            params, state, loss = step(params, state, tgt8)
+            jax.block_until_ready(loss)
+        ckpt.save(str(tmp_path), {"params": params}, step=5)
+        err_before = float(jnp.abs(params["x"] - target_val).max())
+
+        # phase 2: restart on 4 of the 8 devices
+        bf.shutdown()
+        bf.init(devices=cpu_devices[:4], nodes_per_machine=1)
+        bf.set_topology(tu.ExponentialTwoGraph(4), is_weighted=True)
+        try:
+            restored, at = ckpt.restore_latest(str(tmp_path))
+            assert at == 5
+            params4 = ckpt.resize_distributed(restored["params"], 4)
+            strategy4, step4 = make(4)
+            state4 = bfopt.init_distributed(strategy4, params4)
+            tgt4 = jnp.ones((4, 1, 5)) * target_val
+            for _ in range(10):
+                params4, state4, loss = step4(params4, state4, tgt4)
+                jax.block_until_ready(loss)
+            err_after = float(jnp.abs(params4["x"] - target_val).max())
+            assert err_after < err_before
+        finally:
+            bf.shutdown()
+            bf.init(devices=cpu_devices, nodes_per_machine=1)

@@ -23,7 +23,7 @@ from typing import Any, Optional, Tuple
 import jax
 
 __all__ = ["save", "restore", "restore_latest", "latest_step",
-           "resize_distributed"]
+           "resize_distributed", "AsyncSaver"]
 
 _STEP_DIR = re.compile(r"^step_(\d+)$")
 
@@ -96,6 +96,51 @@ def restore_latest(
     if step is None:
         return None, None
     return restore(os.path.join(directory, f"step_{step}"), template), step
+
+
+class AsyncSaver:
+    """Non-blocking checkpointing: ``save`` returns once the on-device
+    state is snapshotted; serialization/IO runs on orbax's background
+    threads while training continues.  The training loop only stalls if a
+    new save starts before the previous one finished (``wait_until_finished``
+    is called to serialize them) — the reference's training scripts block on
+    ``torch.save`` for the full write.
+
+    Usage::
+
+        saver = checkpoint.AsyncSaver()
+        for step in ...:
+            ...
+            if step % k == 0:
+                saver.save(directory, state, step)
+        saver.close()         # drain before exiting
+    """
+
+    def __init__(self):
+        import orbax.checkpoint as ocp
+        self._ckpt = ocp.AsyncCheckpointer(ocp.PyTreeCheckpointHandler())
+
+    def save(self, directory: str, state: Any, step: int) -> str:
+        directory = os.path.abspath(directory)
+        os.makedirs(directory, exist_ok=True)
+        path = os.path.join(directory, f"step_{int(step)}")
+        state = jax.block_until_ready(state)
+        self._ckpt.save(path, state, force=True)
+        return path
+
+    def wait(self) -> None:
+        """Block until every in-flight save is durably on disk."""
+        self._ckpt.wait_until_finished()
+
+    def close(self) -> None:
+        self.wait()
+        self._ckpt.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
 
 
 def resize_distributed(state: Any, new_size: int, *, mode: str = "slice") -> Any:

@@ -157,3 +157,20 @@ class TestElasticResize:
         finally:
             bf.shutdown()
             bf.init(devices=cpu_devices, nodes_per_machine=1)
+
+
+def test_async_saver_roundtrip(tmp_path):
+    tree = {"w": jnp.asarray(
+        np.random.default_rng(3).normal(size=(N, 4)), jnp.float32)}
+    with ckpt.AsyncSaver() as saver:
+        p1 = saver.save(str(tmp_path), tree, step=1)
+        tree2 = jax.tree.map(lambda x: x + 1, tree)
+        saver.save(str(tmp_path), tree2, step=2)
+        saver.wait()
+    assert ckpt.all_steps(str(tmp_path)) == [1, 2]
+    out = ckpt.restore(p1, template=tree)
+    np.testing.assert_array_equal(np.asarray(out["w"]), np.asarray(tree["w"]))
+    out2, at = ckpt.restore_latest(str(tmp_path), template=tree)
+    assert at == 2
+    np.testing.assert_array_equal(np.asarray(out2["w"]),
+                                  np.asarray(tree["w"]) + 1)

@@ -36,6 +36,28 @@ CHILD = textwrap.dedent("""
         got = float(np.asarray(shard.data)[0, 0])
         expected = (r + (r - 1) %% n + (r + 1) %% n) / 3.0
         assert abs(got - expected) < 1e-5, (r, got, expected)
+    # ZeRO-1 train step across the process boundary: reduce-scatter +
+    # all-gather collectives span both processes' device sets
+    import optax
+    from bluefog_tpu import optimizers as bfopt
+
+    def grad_fn(params, batch):
+        return jax.value_and_grad(
+            lambda p: jnp.mean((p["w"] - batch) ** 2))(params)
+
+    strat = bfopt.zero_gradient_allreduce(optax.sgd(0.2))
+    shard = lambda t: jax.tree.map(bf.shard_distributed, t)
+    params = shard({"w": jnp.broadcast_to(jnp.arange(float(n))[:, None], (n, 5))})
+    state = shard(bfopt.init_distributed(strat, params))
+    step = bfopt.make_train_step(grad_fn, strat)
+    target = bf.shard_distributed(jnp.full((n, 5), 2.0))
+    loss0 = None
+    for _ in range(5):
+        params, state, loss = step(params, state, target)
+        l = float(np.mean([np.asarray(sh.data)
+                           for sh in bf.synchronize(loss).addressable_shards]))
+        loss0 = l if loss0 is None else loss0
+    assert l < loss0, (l, loss0)
     print(f"proc {jax.process_index()}: MULTIHOST-OK", flush=True)
 """ % REPO)
 

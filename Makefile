@@ -36,7 +36,14 @@ test_examples:
 	$(PY) examples/benchmark.py --virtual-cpu --model mlp --num-iters 3
 	$(PY) examples/benchmark.py --virtual-cpu --model mlp --num-iters 3 \
 		--dist-optimizer allreduce
+	$(PY) examples/benchmark.py --virtual-cpu --model mlp --num-iters 3 \
+		--dist-optimizer zero_allreduce
+	$(PY) examples/benchmark.py --virtual-cpu --model mlp --num-iters 3 \
+		--dist-optimizer choco
 	$(PY) examples/long_context.py --virtual-cpu --steps 10
+	$(PY) examples/long_context.py --virtual-cpu --steps 10 \
+		--sp-layout zigzag --rope
+	$(PY) examples/moe.py --virtual-cpu --steps 20
 	$(PY) examples/pipeline_lm.py --virtual-cpu --steps 30
 
 # build the native (C++) components explicitly (otherwise built lazily)

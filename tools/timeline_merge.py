@@ -1,0 +1,44 @@
+"""Merge per-process timeline files into one Chrome-tracing view.
+
+The reference writes one ``${BLUEFOG_TIMELINE}<rank>.json`` per rank and
+leaves merging to the user (``docs``); multi-process runs here likewise
+produce one ``<prefix>.activities.json`` per process.  This tool stitches
+them into a single trace with one process row per rank, so
+chrome://tracing / Perfetto shows the whole cluster's activity alignment
+(gossip spans lining up across ranks = the schedule is synchronous; gaps =
+stragglers).
+
+Usage: python tools/timeline_merge.py out.json rank0.activities.json \
+           rank1.activities.json ...
+"""
+import json
+import sys
+
+
+def merge(paths):
+    events = []
+    for i, path in enumerate(paths):
+        with open(path) as f:
+            data = json.load(f)
+        for ev in data.get("traceEvents", []):
+            ev = dict(ev)
+            ev["pid"] = i            # one process row per input file
+            events.append(ev)
+        events.append({
+            "name": "process_name", "ph": "M", "pid": i,
+            "args": {"name": f"rank {i} ({path})"},
+        })
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def main():
+    if len(sys.argv) < 3:
+        raise SystemExit(__doc__)
+    out, paths = sys.argv[1], sys.argv[2:]
+    with open(out, "w") as f:
+        json.dump(merge(paths), f)
+    print(f"merged {len(paths)} timelines -> {out}")
+
+
+if __name__ == "__main__":
+    main()

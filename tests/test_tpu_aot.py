@@ -411,3 +411,40 @@ def test_zigzag_backward_lowers_through_mosaic(tpu_mesh):
         sharding=NamedSharding(tpu_mesh, P(None, "rank"))) for _ in range(3))
     txt = fn.lower(*sds).compile().as_text()
     assert txt.count("tpu_custom_call") == 6
+
+
+def test_choco_step_carries_int8_diffs_on_wire(tpu_mesh):
+    """The CHOCO train step's permutes carry s8 payloads (the compressed
+    DIFFERENCES) — no full-precision f32/bf16 parameter buffer crosses the
+    wire, and the error-feedback state stays device-local."""
+    sched = sch.compile_topology(tu.ExponentialTwoGraph(N), weighted=True)
+    strat = bfopt.choco_gossip(optax.sgd(0.01), sched, wire="int8")
+    dim = 128
+
+    def per_rank(params, state, batch):
+        params, state, batch = jax.tree.map(
+            lambda t: t[0], (params, state, batch))
+        loss, grads = jax.value_and_grad(
+            lambda p: jnp.mean((batch @ p["w"]).astype(jnp.float32) ** 2))(
+                params)
+        params, state = strat.update(grads, state, params)
+        return jax.tree.map(lambda t: t[None], (params, state, loss))
+
+    fn = jax.jit(jax.shard_map(
+        per_rank, mesh=tpu_mesh, in_specs=(P("rank"),) * 3,
+        out_specs=(P("rank"),) * 3), donate_argnums=(0, 1))
+    params = {"w": jnp.zeros((N, dim, dim), jnp.float32)}
+    state0 = strat.init(jax.tree.map(lambda x: x[0], params))
+    state = jax.tree.map(
+        lambda x: jnp.broadcast_to(x, (N,) + x.shape), state0)
+    batch = jnp.zeros((N, 16, dim), jnp.float32)
+    sds = _sharded_sds((params, state, batch), tpu_mesh)
+    txt = fn.lower(*sds).compile().as_text()
+
+    starts = _op_lines(txt, "collective-permute-start")
+    lines = txt.splitlines()
+    payloads = [l for l in starts if re.search(r"s8\[", lines[l])]
+    # 3 Exp2 rounds of (s8 payload + f32 scalar scale); every large buffer
+    # on the wire is s8 — f32 permutes may only carry the scalar scale
+    assert len(payloads) == 3, [lines[l][:100] for l in starts]
+    assert not any(re.search(r"f32\[\d{3,}", lines[l]) for l in starts)

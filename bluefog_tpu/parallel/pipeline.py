@@ -34,7 +34,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-__all__ = ["pipeline_apply", "last_stage_value"]
+__all__ = ["pipeline_apply", "last_stage_value", "pipeline_1f1b_grad"]
 
 Axis = str
 
@@ -108,6 +108,119 @@ def pipeline_apply(
     (_, outputs), _ = lax.scan(
         tick, (inbox0, outputs0), jnp.arange(ticks))
     return outputs
+
+
+def pipeline_1f1b_grad(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    loss_fn: Callable[[jax.Array, jax.Array], jax.Array],
+    stage_params: Any,
+    microbatches: jax.Array,
+    targets: jax.Array,
+    *,
+    axis: Axis = "stage",
+) -> Tuple[jax.Array, Any]:
+    """One-forward-one-backward pipeline training step with O(S) activation
+    memory (vs :func:`pipeline_apply` + autodiff's O(M + S) stash).
+
+    The schedule interleaves backward work as soon as a microbatch clears
+    the last stage instead of running all forwards first: stage ``s``
+    forwards microbatch ``m`` at tick ``s + m`` and backwards it at tick
+    ``2S - 1 - s + m``, so a stashed stage input lives at most ``2S - 1``
+    ticks — the circular buffer is ``min(M, 2S - 1)`` slots no matter how
+    many microbatches flow through (PipeDream-flush/1F1B; the bubble stays
+    ``2(S-1)`` ticks).  Each backward tick recomputes its stage forward from
+    the stashed *input* via ``jax.vjp`` (activation recomputation), so the
+    stash holds inputs only, like ``pipeline_apply(remat=True)``.
+
+    Args:
+      stage_fn: ``(params, x) -> y``, one stage (same contract as
+        :func:`pipeline_apply`).
+      loss_fn: ``(y, target) -> scalar`` applied per microbatch on the LAST
+        stage's output.
+      stage_params: this device's stage parameters.
+      microbatches: ``[M, ...]`` inputs (read by stage 0).
+      targets: ``[M, ...]`` per-microbatch targets (read by the last stage).
+
+    Returns:
+      ``(loss, dparams)``: the summed loss (real on the last stage, zeros
+      elsewhere — see :func:`last_stage_value`) and this stage's parameter
+      gradient, already summed over microbatches.
+    """
+    n_stage = lax.axis_size(axis)
+    sid = lax.axis_index(axis)
+    M = microbatches.shape[0]
+    S = n_stage
+    act_shape = microbatches.shape[1:]
+    act_dtype = microbatches.dtype
+    buf = min(M, 2 * S - 1)
+    ticks = M + 2 * (S - 1) + 1          # last bwd: t_b(0, M-1) = 2S-2+M
+
+    fwd_perm = tuple((i, i + 1) for i in range(S - 1))
+    bwd_perm = tuple((i + 1, i) for i in range(S - 1))
+
+    def fwd_tick(t, params, stash, fwd_inbox):
+        """GPipe forward slot: compute mb (t - sid) if in range, stash the
+        stage input, ship the activation downstream."""
+        my_mb = t - sid
+        valid = (my_mb >= 0) & (my_mb < M)
+        mb_idx = jnp.clip(my_mb, 0, M - 1)
+        x0 = lax.dynamic_index_in_dim(microbatches, mb_idx, keepdims=False)
+        x = jnp.where(sid == 0, x0, fwd_inbox)
+        y = stage_fn(params, x)
+        y = jnp.where(valid, y, jnp.zeros_like(y))
+        slot = mb_idx % buf
+        cur = lax.dynamic_index_in_dim(stash, slot, keepdims=False)
+        stash = lax.dynamic_update_index_in_dim(
+            stash, jnp.where(valid, x, cur), slot, axis=0)
+        out = lax.ppermute(y, axis, perm=fwd_perm) if fwd_perm else y
+        return stash, out
+
+    def bwd_tick(t, params, stash, bwd_inbox, dparams, loss_acc):
+        """1F1B backward slot: recompute mb (t - (2S-1-sid))'s stage forward
+        from the stashed input, pull the cotangent (loss grad on the last
+        stage, upstream delivery elsewhere), accumulate dparams, ship dx."""
+        my_mb = t - (2 * S - 1 - sid)
+        valid = (my_mb >= 0) & (my_mb < M)
+        mb_idx = jnp.clip(my_mb, 0, M - 1)
+        x = lax.dynamic_index_in_dim(stash, mb_idx % buf, keepdims=False)
+        y, vjp = jax.vjp(stage_fn, params, x)
+        tgt = lax.dynamic_index_in_dim(targets, mb_idx, keepdims=False)
+        loss, dloss_dy = jax.value_and_grad(loss_fn)(y, tgt)
+        dy = jnp.where(sid == S - 1, dloss_dy.astype(y.dtype), bwd_inbox)
+        dy = jnp.where(valid, dy, jnp.zeros_like(dy))
+        dp, dx = vjp(dy)
+        dparams = jax.tree.map(
+            lambda a, g: a + jnp.where(valid, g, jnp.zeros_like(g)),
+            dparams, dp)
+        loss_acc = loss_acc + jnp.where(
+            valid & (sid == S - 1), loss, jnp.zeros_like(loss))
+        out = lax.ppermute(dx, axis, perm=bwd_perm) if bwd_perm else dx
+        return dparams, loss_acc, out
+
+    def tick(carry, t):
+        stash, fwd_inbox, bwd_inbox, dparams, loss_acc = carry
+        # bwd BEFORE fwd: the backward's stash entry is always from a
+        # strictly earlier tick (t_f = t - (2S-1-2s) < t), while this tick's
+        # forward may REUSE that slot (stage 0 with a full window) — reading
+        # first makes the circular buffer safe at its minimal size
+        dparams, loss_acc, bwd_inbox = bwd_tick(
+            t, stage_params, stash, bwd_inbox, dparams, loss_acc)
+        stash, fwd_inbox = fwd_tick(t, stage_params, stash, fwd_inbox)
+        return (stash, fwd_inbox, bwd_inbox, dparams, loss_acc), None
+
+    vary = lambda x: lax.pcast(x, axis, to='varying')
+    carry0 = (
+        vary(jnp.zeros((buf,) + act_shape, act_dtype)),          # stash
+        vary(jnp.zeros(act_shape, act_dtype)),                   # fwd inbox
+        vary(jnp.zeros(act_shape, act_dtype)),                   # bwd inbox
+        jax.tree.map(lambda p: vary(jnp.zeros(p.shape, jnp.float32)),
+                     stage_params),                              # dparams
+        vary(jnp.zeros((), jnp.float32)),                        # loss
+    )
+    (_, _, _, dparams, loss), _ = lax.scan(tick, carry0, jnp.arange(ticks))
+    dparams = jax.tree.map(
+        lambda g, p: g.astype(p.dtype), dparams, stage_params)
+    return loss, dparams
 
 
 def last_stage_value(x: jax.Array, *, axis: Axis = "stage") -> jax.Array:

@@ -89,19 +89,24 @@ def _vma_of(x: jax.Array):
     return getattr(jax.typeof(x), "vma", frozenset()) or frozenset()
 
 
-def _apply_causal_mask(s, qoff_ref, koff_ref, block_q: int):
+def _apply_causal_mask(s, qoff_ref, koff_ref, block_q: int, window: int = 0):
     """In-kernel: mask scores above the diagonal given the global offsets of
-    this grid step's q rows (``qoff + j*block_q``) and the K block."""
+    this grid step's q rows (``qoff + j*block_q``) and the K block.
+    ``window > 0`` additionally masks keys more than ``window - 1`` tokens
+    behind the query (sliding-window attention)."""
     tq, tk = s.shape
     base = qoff_ref[0] + pl.program_id(1) * block_q
     q_pos = base + jax.lax.broadcasted_iota(jnp.int32, (tq, tk), 0)
     k_pos = koff_ref[0] + jax.lax.broadcasted_iota(jnp.int32, (tq, tk), 1)
-    return jnp.where(q_pos >= k_pos, s, NEG_INF)
+    keep = q_pos >= k_pos
+    if window:
+        keep = keep & (q_pos - k_pos < window)
+    return jnp.where(keep, s, NEG_INF)
 
 
 def _partial_kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref,
                     o_ref, l_ref, m_ref, *, causal: bool, scale: float,
-                    block_q: int):
+                    block_q: int, window: int = 0):
     q = q_ref[0].astype(jnp.float32) * scale          # [QB, D]
     k = k_ref[0].astype(jnp.float32)                  # [Tk, D]
     v = v_ref[0].astype(jnp.float32)                  # [Tk, D]
@@ -109,7 +114,7 @@ def _partial_kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref,
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32)           # [QB, Tk]
     if causal:
-        s = _apply_causal_mask(s, qoff_ref, koff_ref, block_q)
+        s = _apply_causal_mask(s, qoff_ref, koff_ref, block_q, window)
     m = jnp.max(s, axis=-1, keepdims=True)            # [Tq, 1]
     safe_m = jnp.where(m <= NEG_INF / 2, 0.0, m)
     p = jnp.exp(s - safe_m)
@@ -124,7 +129,8 @@ def _partial_kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref,
 
 
 @functools.partial(
-    jax.jit, static_argnames=("causal", "scale", "interpret", "block_q"))
+    jax.jit,
+    static_argnames=("causal", "scale", "interpret", "block_q", "window"))
 def attention_block_partial(
     q: jax.Array,                  # [B, Tq, H, D]
     k: jax.Array,                  # [B, Tk, Hkv, D] — Hkv may divide H (GQA)
@@ -136,8 +142,11 @@ def attention_block_partial(
     scale: float = 1.0,
     interpret: Optional[bool] = None,
     block_q: int = 512,
+    window: int = 0,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """One K/V block's flash-attention partial, fully in VMEM.
+    ``window > 0`` (needs ``causal``): sliding-window masking — keys more
+    than ``window - 1`` tokens behind the query are masked.
 
     Returns ``(o_blk [B,Tq,H,D] f32, l_blk [B,Tq,H] f32, m_blk [B,Tq,H] f32)``
     relative to the block max ``m_blk`` (rows with no valid key get
@@ -155,7 +164,7 @@ def attention_block_partial(
     kr, vr = _split_heads(k), _split_heads(v)
 
     kernel = functools.partial(_partial_kernel, causal=causal, scale=scale,
-                               block_q=qb)
+                               block_q=qb, window=window)
     vma = _vma_of(qr)
     o, l, m = pl.pallas_call(
         kernel,
@@ -189,7 +198,7 @@ def attention_block_partial(
 def _backward_kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref, do_ref,
                      lse_ref, delta_ref, dq_ref, dk_ref, dv_ref, *,
                      causal: bool, scale: float, block_q: int,
-                     num_heads: int = 0, group: int = 1):
+                     num_heads: int = 0, group: int = 1, window: int = 0):
     """Flash-attention backward for one K/V block, scores recomputed in VMEM.
 
     Standard FlashAttention-2 backward recurrence with the *global* softmax
@@ -215,7 +224,7 @@ def _backward_kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref, do_ref,
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32) * scale   # [QB, Tk]
     if causal:
-        s = _apply_causal_mask(s, qoff_ref, koff_ref, block_q)
+        s = _apply_causal_mask(s, qoff_ref, koff_ref, block_q, window)
     safe_lse = jnp.where(jnp.isneginf(lse), 0.0, lse)
     p = jnp.exp(s - safe_lse)
     # masked scores and rows with no valid keys (padded rows carry lse=-inf)
@@ -256,7 +265,8 @@ def _backward_kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref, do_ref,
 
 
 @functools.partial(
-    jax.jit, static_argnames=("causal", "scale", "interpret", "block_q"))
+    jax.jit,
+    static_argnames=("causal", "scale", "interpret", "block_q", "window"))
 def attention_block_backward(
     q: jax.Array,                  # [B, Tq, H, D]
     k: jax.Array,                  # [B, Tk, Hkv, D] — Hkv may divide H (GQA)
@@ -271,6 +281,7 @@ def attention_block_backward(
     scale: float = 1.0,
     interpret: Optional[bool] = None,
     block_q: int = 512,
+    window: int = 0,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """One K/V block's backward partial: ``(dq, dk_blk, dv_blk)``, all f32.
 
@@ -296,7 +307,8 @@ def attention_block_backward(
     deltar = _pad_rows(_split_heads(delta.astype(jnp.float32)[..., None]), pad)
 
     kernel = functools.partial(_backward_kernel, causal=causal, scale=scale,
-                               block_q=qb, num_heads=H, group=group)
+                               block_q=qb, num_heads=H, group=group,
+                               window=window)
     vma = _vma_of(qr)
     dq, dk, dv = pl.pallas_call(
         kernel,

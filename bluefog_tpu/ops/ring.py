@@ -68,6 +68,7 @@ def ring_attention(
     pallas_block_q: int = 512,
     pallas_interpret: Optional[bool] = None,
     layout: str = "contiguous",
+    window: Optional[int] = None,
 ) -> jax.Array:
     """Exact attention over a sequence sharded along ``axis``.
 
@@ -102,6 +103,15 @@ def ring_attention(
         scale = 1.0 / np.sqrt(d)
     if layout not in ("contiguous", "zigzag"):
         raise ValueError(f"unknown layout {layout!r}")
+    if window is not None:
+        if not causal:
+            raise ValueError("sliding-window attention needs causal=True")
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+        if layout == "zigzag":
+            raise ValueError(
+                "window is a contiguous-layout feature (the zigzag "
+                "visibility table assumes full causal attention)")
     if layout == "zigzag":
         if not causal:
             raise ValueError(
@@ -122,8 +132,23 @@ def ring_attention(
     if use_pallas:
         return _pallas_ring_attention(
             q, k, v, axis, causal, float(scale), pallas_block_q,
-            pallas_interpret)
-    return _jnp_ring_attention(q, k, v, axis, causal, float(scale))
+            pallas_interpret, window or 0)
+    return _jnp_ring_attention(q, k, v, axis, causal, float(scale),
+                               window or 0)
+
+
+def _block_visible(idx, src, blk_q: int, blk_k: int, causal: bool,
+                   window: int):
+    """Block-level visibility of K/V block ``src`` for device ``idx``'s
+    queries: False only when EVERY (q, k) position pair is masked —
+    causally (whole block in the future) or by the sliding window (whole
+    block more than ``window`` tokens behind)."""
+    if not causal:
+        return None                       # everything visible, no cond
+    vis = idx * blk_q + blk_q - 1 >= src * blk_k
+    if window:
+        vis = vis & (idx * blk_q - (src * blk_k + blk_k - 1) < window)
+    return vis
 
 
 def zigzag_order(n: int, total_len: int) -> np.ndarray:
@@ -354,7 +379,7 @@ def _zigzag_impl(q, k, v, axis: Axis, scale: float,
 
 def _pallas_forward(q, k, v, axis: Axis, causal: bool, scale: float,
                     block_q: int = 512, interpret: Optional[bool] = None,
-                    return_lse: bool = False):
+                    window: int = 0, return_lse: bool = False):
     from . import pallas_attention as pa
     n = lax.axis_size(axis)
     idx = lax.axis_index(axis)
@@ -368,10 +393,19 @@ def _pallas_forward(q, k, v, axis: Axis, causal: bool, scale: float,
     def pstep(carry, t):
         o, l, m, kt, vt = carry
         src = (idx - t) % n
-        part = pa.attention_block_partial(
-            q, kt, vt, idx * blk_q, src * blk_k,
-            causal=causal, scale=scale, block_q=block_q, interpret=interpret)
-        o, l, m = pa.merge_partials((o, l, m), part)
+
+        def compute(olm):
+            part = pa.attention_block_partial(
+                q, kt, vt, idx * blk_q, src * blk_k,
+                causal=causal, scale=scale, block_q=block_q,
+                interpret=interpret, window=window)
+            return pa.merge_partials(olm, part)
+
+        vis = _block_visible(idx, src, blk_q, blk_k, causal, window)
+        if vis is None:
+            o, l, m = compute((o, l, m))
+        else:
+            o, l, m = lax.cond(vis, compute, lambda olm: olm, (o, l, m))
         kt = lax.ppermute(kt, axis, perm=perm_p)
         vt = lax.ppermute(vt, axis, perm=perm_p)
         return (o, l, m, kt, vt), None
@@ -386,10 +420,11 @@ def _pallas_forward(q, k, v, axis: Axis, causal: bool, scale: float,
     return out, lse
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
 def _pallas_ring_attention(q, k, v, axis: Axis, causal: bool, scale: float,
                            block_q: int = 512,
-                           interpret: Optional[bool] = None):
+                           interpret: Optional[bool] = None,
+                           window: int = 0):
     """Pallas forward with a Pallas flash backward.
 
     Forward keeps each block's score tile in VMEM and saves only
@@ -399,17 +434,19 @@ def _pallas_ring_attention(q, k, v, axis: Axis, causal: bool, scale: float,
     fully reduced after n steps — no [T, T] matrix ever exists in HBM in
     either direction.
     """
-    return _pallas_forward(q, k, v, axis, causal, scale, block_q, interpret)
+    return _pallas_forward(q, k, v, axis, causal, scale, block_q, interpret,
+                           window)
 
 
 def _pallas_ring_fwd(q, k, v, axis, causal, scale, block_q=512,
-                     interpret=None):
+                     interpret=None, window=0):
     out, lse = _pallas_forward(
-        q, k, v, axis, causal, scale, block_q, interpret, return_lse=True)
+        q, k, v, axis, causal, scale, block_q, interpret, window,
+        return_lse=True)
     return out, (q, k, v, out, lse)
 
 
-def _pallas_ring_bwd(axis, causal, scale, block_q, interpret, res, g):
+def _pallas_ring_bwd(axis, causal, scale, block_q, interpret, window, res, g):
     from . import pallas_attention as pa
     q, k, v, out, lse = res
     n = lax.axis_size(axis)
@@ -426,12 +463,21 @@ def _pallas_ring_bwd(axis, causal, scale, block_q, interpret, res, g):
     def bstep(carry, t):
         dq, kt, vt, dkt, dvt = carry
         src = (idx - t) % n
-        dq_p, dk_p, dv_p = pa.attention_block_backward(
-            q, kt, vt, do, lse, delta, idx * blk_q, src * blk_k,
-            causal=causal, scale=scale, block_q=block_q, interpret=interpret)
-        dq = dq + dq_p
-        dkt = dkt + dk_p
-        dvt = dvt + dv_p
+
+        def compute(acc):
+            dq, dkt, dvt = acc
+            dq_p, dk_p, dv_p = pa.attention_block_backward(
+                q, kt, vt, do, lse, delta, idx * blk_q, src * blk_k,
+                causal=causal, scale=scale, block_q=block_q,
+                interpret=interpret, window=window)
+            return dq + dq_p, dkt + dk_p, dvt + dv_p
+
+        vis = _block_visible(idx, src, blk_q, blk_k, causal, window)
+        if vis is None:
+            dq, dkt, dvt = compute((dq, dkt, dvt))
+        else:
+            dq, dkt, dvt = lax.cond(vis, compute, lambda a: a,
+                                    (dq, dkt, dvt))
         # dk/dv accumulators travel with their K/V block around the ring
         kt = lax.ppermute(kt, axis, perm=perm_p)
         vt = lax.ppermute(vt, axis, perm=perm_p)
@@ -465,7 +511,8 @@ def online_softmax_merge(o, l, m, s, vt):
     return o, l, m_new
 
 
-def _jnp_ring_attention(q, k, v, axis: Axis, causal: bool, scale: float):
+def _jnp_ring_attention(q, k, v, axis: Axis, causal: bool, scale: float,
+                        window: int = 0):
     n = lax.axis_size(axis)
     idx = lax.axis_index(axis)
     blk_q, blk_k = q.shape[1], k.shape[1]
@@ -482,9 +529,8 @@ def _jnp_ring_attention(q, k, v, axis: Axis, causal: bool, scale: float):
 
     G = q.shape[2] // k.shape[2]     # GQA group (1 = standard MHA)
 
-    def step(carry, t):
-        o, l, m, kt, vt = carry
-        src = (idx - t) % n                                      # owner of current kv block
+    def compute(olm, kt, vt, src):
+        o, l, m = olm
         # GQA: the ring rotates the COMPACT kv (G x fewer permute bytes).
         # jnp.repeat materializes the expanded block per step — acceptable
         # on this fallback path; the pallas kernel path expands nothing
@@ -495,12 +541,28 @@ def _jnp_ring_attention(q, k, v, axis: Axis, causal: bool, scale: float):
         s = jnp.einsum("bihd,bjhd->bihj", qf, kte.astype(jnp.float32))
         if causal:
             k_pos = src * blk_k + jnp.arange(blk_k)
-            mask = q_pos[:, None, None] >= k_pos[None, None, :]  # [Tq, 1, Tk]
-            s = jnp.where(mask[None], s, -jnp.inf)
-        o, l, m_new = online_softmax_merge(o, l, m, s, vte)
+            keep = q_pos[:, None, None] >= k_pos[None, None, :]  # [Tq, 1, Tk]
+            if window:
+                keep = keep & (q_pos[:, None, None] - k_pos[None, None, :]
+                               < window)
+            s = jnp.where(keep[None], s, -jnp.inf)
+        return online_softmax_merge(o, l, m, s, vte)
+
+    def step(carry, t):
+        o, l, m, kt, vt = carry
+        src = (idx - t) % n                                      # owner of current kv block
+        vis = _block_visible(idx, src, blk_q, blk_k, causal, window)
+        if vis is None:
+            o, l, m = compute((o, l, m), kt, vt, src)
+        else:
+            # skip fully-masked blocks (future, or beyond the window):
+            # with a window each device computes O(window/blk) blocks/step
+            o, l, m = lax.cond(
+                vis, lambda olm: compute(olm, kt, vt, src),
+                lambda olm: olm, (o, l, m))
         kt = lax.ppermute(kt, axis, perm=perm)
         vt = lax.ppermute(vt, axis, perm=perm)
-        return (o, l, m_new, kt, vt), None
+        return (o, l, m, kt, vt), None
 
     (o, l, _, _, _), _ = lax.scan(step, (o0, l0, m0, k, v), jnp.arange(n))
     l = jnp.where(l == 0.0, 1.0, l)                              # fully-masked rows

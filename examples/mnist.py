@@ -32,6 +32,7 @@ def main():
     parser.add_argument("--virtual-cpu", action="store_true")
     parser.add_argument("--dist-optimizer", default="neighbor_allreduce",
                         choices=["neighbor_allreduce", "gradient_allreduce",
+                                 "zero_allreduce", "choco",
                                  "allreduce", "hierarchical_neighbor_allreduce",
                                  "win_put", "push_sum", "empty"])
     parser.add_argument("--atc", action="store_true",
@@ -100,6 +101,10 @@ def main():
     name = args.dist_optimizer
     if name == "gradient_allreduce":
         strategy = bfopt.gradient_allreduce(opt)
+    elif name == "zero_allreduce":
+        strategy = bfopt.zero_gradient_allreduce(opt)
+    elif name == "choco":
+        strategy = bfopt.choco_gossip(opt)
     elif name == "win_put":
         strategy = bfopt.DistributedWinPutOptimizer(opt)
     elif name == "push_sum":

@@ -40,6 +40,10 @@ test_examples:
 		--dist-optimizer zero_allreduce
 	$(PY) examples/benchmark.py --virtual-cpu --model mlp --num-iters 3 \
 		--dist-optimizer choco
+	$(PY) examples/mnist.py --virtual-cpu --epochs 1
+	$(PY) examples/mnist.py --virtual-cpu --epochs 1 --dynamic-topology --atc
+	$(PY) examples/resnet.py --virtual-cpu --epochs 1 --warmup-epochs 0 \
+		--train-size 256 --batch-size 8
 	$(PY) examples/long_context.py --virtual-cpu --steps 10
 	$(PY) examples/long_context.py --virtual-cpu --steps 10 \
 		--sp-layout zigzag --rope

@@ -391,6 +391,10 @@ def hard_sync(x):
             # single-element index, not ravel(): a dynamic-slice costs O(1),
             # where ravel dispatches a full-buffer copy inside the timed
             # window this barrier is meant to close
+            if not leaf.is_fully_addressable:
+                # multi-process arrays can't be basic-indexed from one host;
+                # fetching an element of the local shard is the same barrier
+                leaf = leaf.addressable_shards[0].data
             jax.device_get(leaf if leaf.ndim == 0 else leaf[(0,) * leaf.ndim])
     return x
 

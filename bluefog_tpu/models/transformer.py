@@ -24,6 +24,9 @@ def apply_rope(x: jax.Array, positions: jax.Array,
     sequence sharding — each device rotates its own q/k by its own global
     positions and ring/zigzag/ulysses attention stays exact."""
     d = x.shape[-1]
+    if d % 2:
+        raise ValueError(f"rope needs an even head_dim, got {d}: the "
+                         "rotation pairs channel i with channel i + d//2")
     half = d // 2
     freqs = base ** (-jnp.arange(half, dtype=jnp.float32) / half)
     ang = positions.astype(jnp.float32)[:, None] * freqs[None]     # [T, half]

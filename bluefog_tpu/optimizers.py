@@ -539,12 +539,23 @@ def choco_gossip(
 
     def _scheds():
         s = sched if sched is not None else _mesh.static_schedule()
+        if s.uses_dst_weighting and wire != "int8":
+            # the s-tracking invariant s_i == sum_j w_ij xhat_j needs
+            # deq(Q(.)) to commute with the sender-side dst scaling; int8's
+            # symmetric per-buffer quantization is scale-invariant (the
+            # scale rides the wire) but a bf16 cast is not — the public
+            # copies would silently drift from what crossed the wire.
+            raise ValueError(
+                "choco_gossip with a dst-weighted schedule "
+                "(uses_dst_weighting=True) requires wire='int8'; "
+                f"wire={wire!r} does not commute with send scaling")
         # zero-self variant: the permute rounds carry neighbors' diffs only;
         # the self term is applied locally (full knowledge of own q)
         s0 = _dc.replace(s, self_weight=np.zeros_like(s.self_weight), key="")
         return s, s0
 
     def init(params):
+        _scheds()                     # fail fast on wire/schedule mismatch
         bufs = fusion.fuse_tree(jax.tree.map(jnp.copy, params)).buffers
         # identical starts => xhat_j == x_0 for all j and row-stochastic
         # weights make s = sum_j w_ij xhat_j = x_0 as well
@@ -804,13 +815,20 @@ def zero_gradient_allreduce(
 ) -> DecentralizedOptimizer:
     """Synchronous data parallelism with ZeRO-1 sharded optimizer state.
 
-    Same trajectory as :func:`gradient_allreduce` (the adapt is elementwise,
-    so sharding it is exact), but each chip stores only ``1/n`` of the
-    optimizer state: grads are ``reduce_scatter``'d, the local shard is
-    stepped, and updated params are ``all_gather``'d — the classic ZeRO
-    stage-1 dataflow mapped onto ICI collectives.  Beyond-reference: the
-    reference is replicated-state-only (``optimizers.py:166-294``); this is
-    what makes billion-parameter models fit the strategy on TPU.
+    Same trajectory as :func:`gradient_allreduce` **provided the optax chain
+    is elementwise** — this is a hard requirement, not an optimization note.
+    The adapt runs on flat per-dtype shard buffers, not the user's param
+    pytree, so transforms that depend on tree structure or couple elements
+    across the tree (``optax.masked`` weight decay, ``multi_transform``,
+    ``clip_by_global_norm``) see a different tree/norm than they would
+    unsharded and silently diverge from ``gradient_allreduce``.  Plain
+    sgd/momentum/adam/adamw chains are elementwise and exact.  Each chip
+    stores only ``1/n`` of the optimizer state: grads are
+    ``reduce_scatter``'d, the local shard is stepped, and updated params are
+    ``all_gather``'d — the classic ZeRO stage-1 dataflow mapped onto ICI
+    collectives.  Beyond-reference: the reference is replicated-state-only
+    (``optimizers.py:166-294``); this is what makes billion-parameter models
+    fit the strategy on TPU.
 
     Requires params to be identical across ``axis`` (true for this strategy:
     identical init + identical updates), which is why ZeRO composes with the
@@ -860,6 +878,10 @@ def zero_adapt_with_combine(
     hierarchical mode maintains via local allreduce + bcast
     (``mpi_controller.cc:452-507``), but with 1/local_size optimizer-state
     memory and grads averaged in the same collective that shards them.
+
+    Shares :func:`zero_gradient_allreduce`'s hard requirement: the optax
+    chain must be elementwise (the adapt sees flat shard buffers, not the
+    param pytree — tree-structured or global-norm transforms diverge).
     """
     n = shard_axis_size or _zero_axis_size(shard_axis)
 

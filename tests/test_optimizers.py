@@ -195,6 +195,24 @@ def test_push_sum_rejects_dst_weighted_schedule():
         strat.init({"x": jnp.zeros((N, 1, 4))})
 
 
+def test_choco_rejects_dst_weighted_bf16_wire():
+    """CHOCO's s-tracking invariant needs deq(Q(.)) to commute with the
+    sender-side dst scaling: exact for int8 (scale-invariant), drifts for a
+    bf16 cast — so dst-weighted schedules must be rejected unless wire=int8."""
+    from bluefog_tpu import schedule as sched_mod
+    topo = tu.RingGraph(N, connect_style=2)
+    srcs = [{s: 0.25 for s in tu.GetInNeighbors(topo, r)} for r in range(N)]
+    dsts = [{d: 0.25 for d in tu.GetOutNeighbors(topo, r)} for r in range(N)]
+    dst = sched_mod.compile_from_weights(N, [0.5] * N, srcs, dsts)
+    assert dst.uses_dst_weighting
+    strat = bfopt.choco_gossip(optax.sgd(0.03), dst, wire="bf16")
+    with pytest.raises(ValueError, match="int8"):
+        strat.init({"x": jnp.zeros((N, 1, 4))})
+    # int8's per-buffer scale rides the wire, so the same schedule is fine
+    bfopt.choco_gossip(optax.sgd(0.03), dst, wire="int8").init(
+        {"x": jnp.zeros((N, 1, 4))})
+
+
 def test_adam_composes():
     strat = bfopt.DistributedAdaptThenCombineOptimizer(
         optax.adam(0.05), communication_type="neighbor_allreduce")

@@ -253,6 +253,14 @@ class TestRope:
         np.testing.assert_allclose(score(7, 7), score(0, 0), rtol=1e-5)
         assert abs(score(5, 3) - score(5, 4)) > 1e-6   # gap actually matters
 
+    def test_rope_rejects_odd_head_dim(self):
+        """The rotation pairs channel i with i + d//2; an odd head_dim has no
+        valid pairing and must fail loudly, not with an opaque shape error."""
+        from bluefog_tpu.models.transformer import apply_rope
+        x = jnp.zeros((1, 2, 1, 7), jnp.float32)
+        with pytest.raises(ValueError, match="even head_dim"):
+            apply_rope(x, jnp.arange(2))
+
     def test_rope_lm_zigzag_matches_contiguous(self, cpu_devices):
         """RoPE composes with sequence sharding: per-token rotation by
         global position makes the zigzag and contiguous layouts identical."""

@@ -160,6 +160,111 @@ def test_pipeline_trains_to_decreasing_loss(cpu_devices):
     assert np.mean(losses[-10:]) < np.mean(losses[:10])
 
 
+class TestInterleaved:
+    """pipeline_interleaved_apply (V chunks/device, circular ring schedule)
+    == sequential composition over all V*S virtual stages, fwd and bwd."""
+
+    V = 2
+    Mi = 4      # M <= S (the circular-schedule contract)
+
+    def _setup(self, seed=0):
+        rng = np.random.default_rng(seed)
+        n_virtual = self.V * S
+        w = jnp.asarray(rng.normal(size=(n_virtual, D, D)) * 0.5, jnp.float32)
+        b = jnp.asarray(rng.normal(size=(n_virtual, D)) * 0.1, jnp.float32)
+        mb = jnp.asarray(rng.normal(size=(self.Mi, B, D)), jnp.float32)
+        tgt = jnp.asarray(rng.normal(size=(self.Mi, B, D)), jnp.float32)
+        # Megatron placement: device d holds chunks k at virtual stage k*S+d
+        # -> reshaping [V*S, ...] to [V, S, ...] and moving S first gives a
+        # [S, V, ...] array whose stage-axis shard IS the device's chunks
+        chunked = jax.tree.map(
+            lambda p: jnp.moveaxis(
+                p.reshape((self.V, S) + p.shape[1:]), 1, 0),
+            {"w": w, "b": b})
+        return {"w": w, "b": b}, chunked, mb, tgt
+
+    @staticmethod
+    def _stage_fn(p, x):
+        return jnp.tanh(x @ p["w"] + p["b"])
+
+    def _seq(self, full, x):
+        for v in range(self.V * S):
+            x = jnp.tanh(x @ full["w"][v] + full["b"][v])
+        return x
+
+    def test_forward_matches_sequential(self, cpu_devices):
+        from bluefog_tpu.parallel.pipeline import pipeline_interleaved_apply
+        full, chunked, mb, _ = self._setup()
+        mesh = Mesh(np.array(cpu_devices[:S]), ("stage",))
+
+        def f(params, mbs):
+            out = pipeline_interleaved_apply(
+                self._stage_fn, jax.tree.map(lambda p: p[0], params), mbs[0])
+            return out[None]
+
+        fn = jax.jit(jax.shard_map(
+            f, mesh=mesh, in_specs=(P("stage"), P(None)), out_specs=P("stage")))
+        out = np.asarray(fn(chunked, mb[None])[S - 1])
+        np.testing.assert_allclose(out, np.asarray(self._seq(full, mb)),
+                                   rtol=1e-5, atol=1e-6)
+
+    @pytest.mark.parametrize("m", [1, 4])
+    @pytest.mark.parametrize("remat", [False, True])
+    def test_grads_match_sequential(self, cpu_devices, m, remat):
+        from bluefog_tpu.parallel.pipeline import pipeline_interleaved_apply
+        full, chunked, mb, tgt = self._setup(seed=2)
+        mb, tgt = mb[:m], tgt[:m]
+        mesh = Mesh(np.array(cpu_devices[:S]), ("stage",))
+
+        def f(params, mbs, tgts):
+            local = jax.tree.map(lambda p: p[0], params)      # [V, ...]
+
+            def loss(p):
+                out = pipeline_interleaved_apply(
+                    self._stage_fn, p, mbs[0], remat=remat)
+                out = last_stage_value(out, axis="stage")
+                return jnp.mean((out - tgts[0]) ** 2)
+
+            l, g = jax.value_and_grad(loss)(local)
+            return l[None], jax.tree.map(lambda x: x[None], g)
+
+        fn = jax.jit(jax.shard_map(
+            f, mesh=mesh, in_specs=(P("stage"), P(None), P(None)),
+            out_specs=(P("stage"), P("stage"))))
+        l, g = fn(chunked, mb[None], tgt[None])
+
+        def seq_loss(p):
+            return jnp.mean((self._seq(p, mb) - tgt) ** 2)
+
+        lo, go = jax.value_and_grad(seq_loss)(full)
+        np.testing.assert_allclose(np.asarray(l)[0], float(lo),
+                                   rtol=1e-5, atol=1e-7)
+        # regroup the sequential grads into the per-device chunk layout
+        go_chunked = jax.tree.map(
+            lambda p: jnp.moveaxis(
+                p.reshape((self.V, S) + p.shape[1:]), 1, 0), go)
+        for key in ("w", "b"):
+            np.testing.assert_allclose(
+                np.asarray(g[key]), np.asarray(go_chunked[key]),
+                rtol=1e-4, atol=1e-6, err_msg=key)
+
+    def test_rejects_too_many_microbatches(self, cpu_devices):
+        from bluefog_tpu.parallel.pipeline import pipeline_interleaved_apply
+        _, chunked, _, _ = self._setup()
+        mesh = Mesh(np.array(cpu_devices[:S]), ("stage",))
+        mb = jnp.zeros((S + 1, B, D), jnp.float32)
+
+        def f(params, mbs):
+            return pipeline_interleaved_apply(
+                self._stage_fn, jax.tree.map(lambda p: p[0], params),
+                mbs[0])[None]
+
+        fn = jax.jit(jax.shard_map(
+            f, mesh=mesh, in_specs=(P("stage"), P(None)), out_specs=P("stage")))
+        with pytest.raises(ValueError, match="M <= S"):
+            fn(chunked, mb[None])
+
+
 class Test1F1B:
     """pipeline_1f1b_grad == autodiff through the GPipe schedule."""
 

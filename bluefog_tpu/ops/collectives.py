@@ -103,9 +103,14 @@ def neighbor_allreduce(
         if wire is None:
             recv = lax.ppermute(send, axis, perm=sched.rounds[r])
         else:
-            parts = _wire_encode(wire, send)
-            moved = tuple(lax.ppermute(p, axis, perm=sched.rounds[r])
-                          for p in parts)
+            # barriers pin the codec around the permute: XLA's collective
+            # reorderer happily commutes a bare convert across a
+            # collective-permute and fuses encode+decode into a no-op,
+            # which silently puts FULL-WIDTH bytes back on the wire
+            parts = lax.optimization_barrier(_wire_encode(wire, send))
+            moved = lax.optimization_barrier(tuple(
+                lax.ppermute(p, axis, perm=sched.rounds[r])
+                for p in parts))
             recv = _wire_decode(wire, moved, x.dtype)
         acc = acc + recv * _table(sched.recv_weight[r], idx, x.dtype)
     return acc

@@ -285,6 +285,33 @@ def test_int8_wire_shrinks_permute_payload(tpu_mesh):
     assert not any(re.search(r"f32\[\d{4,}", lines[l]) for l in starts)
 
 
+def test_bf16_wire_halves_permute_payload(tpu_mesh):
+    """wire="bf16" on f32 data really halves the TPU wire: the gossip
+    permutes carry bf16 buffers.  Guarded by optimization barriers in
+    neighbor_allreduce — without them XLA commutes the decode convert
+    across the collective-permute and the wire silently reverts to f32
+    (observed on the CPU backend's float normalization; the barrier makes
+    the codec's placement non-negotiable on every backend)."""
+    sched = sch.compile_topology(tu.ExponentialTwoGraph(N))
+
+    def per_rank(x):
+        from bluefog_tpu.ops import collectives as C
+        return C.neighbor_allreduce(x[0], sched, wire="bf16")[None]
+
+    fn = jax.jit(jax.shard_map(
+        per_rank, mesh=tpu_mesh, in_specs=(P("rank"),),
+        out_specs=P("rank")))
+    x = jax.ShapeDtypeStruct(
+        (N, 1024, 1024), jnp.float32,
+        sharding=NamedSharding(tpu_mesh, P("rank")))
+    txt = fn.lower(x).compile().as_text()
+    starts = _op_lines(txt, "collective-permute-start")
+    lines = txt.splitlines()
+    payload = [l for l in starts if re.search(r"bf16\[", lines[l])]
+    assert len(payload) == 3, [lines[l] for l in starts]    # 3 Exp2 rounds
+    assert not any(re.search(r"f32\[\d{4,}", lines[l]) for l in starts)
+
+
 def test_ulysses_kernels_lower_for_tpu(tpu_mesh):
     """ulysses_attention(use_pallas) fwd+bwd compiles through Mosaic for
     v5e, with the head/sequence re-shard lowering to all-to-all — the

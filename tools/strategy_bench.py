@@ -1,0 +1,218 @@
+"""Strategy comparison: wire bytes (from compiled HLO) + step time.
+
+One row per distributed-optimizer strategy on a fixed ~1M-param MLP: which
+collectives the step compiles to, how many bytes each chip puts on the wire
+per optimizer step (counted from the compiled program — ground truth, not
+an analytic estimate), and the measured step time on the current backend.
+Wire bytes come from an AOT compile against an abstract v5e topology when
+libtpu is available (the TPU schedule is the one that matters: the CPU
+backend's float normalization silently upcasts bf16 collectives, hiding
+wire compression); otherwise the current backend's HLO.  The ms column is
+the virtual CPU mesh unless run on real chips.  Counterpart of the
+reference's published strategy table (``docs/performance.rst:26-53``).
+
+Run: python tools/strategy_bench.py --virtual-cpu [--json]
+"""
+import argparse
+import os
+import re
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+_DT_BYTES = {"f64": 8, "u64": 8, "s64": 8, "c64": 8,
+             "f32": 4, "u32": 4, "s32": 4,
+             "bf16": 2, "f16": 2, "u16": 2, "s16": 2,
+             "u8": 1, "s8": 1, "pred": 1}
+
+# ops that move bytes across chips; -done/-update variants reuse the same
+# buffer and must not be double counted
+_COLLECTIVES = ("all-reduce", "collective-permute", "all-gather",
+                "reduce-scatter", "all-to-all")
+
+
+def _shape_bytes(token: str) -> int:
+    m = re.match(r"(\w+)\[([\d,]*)\]", token)
+    if not m or m.group(1) not in _DT_BYTES:
+        return 0
+    dims = [int(d) for d in m.group(2).split(",") if d] or [1]
+    n = 1
+    for d in dims:
+        n *= d
+    return n * _DT_BYTES[m.group(1)]
+
+
+def wire_stats(hlo_txt: str):
+    """Per-chip payload bytes and instruction counts of cross-chip
+    collectives in a compiled (SPMD, per-partition) HLO module."""
+    counts, bytes_ = {}, {}
+    # lazy shape span: TPU layouts carry tile annotations with parens
+    # (`f32[1024]{1,0:T(8,128)}`), so the span can't be a strict char class
+    pat = re.compile(
+        r"= (.*?) (" + "|".join(_COLLECTIVES) + r")(-start)?\(")
+    for line in hlo_txt.splitlines():
+        m = pat.search(line)
+        if not m:
+            continue
+        shapes, op = m.group(1), m.group(2)
+        payload = sum(_shape_bytes(t)
+                      for t in re.findall(r"\w+\[[\d,]*\]", shapes))
+        if m.group(3):                      # async start: (in, out, sync..)
+            payload //= 2
+        counts[op] = counts.get(op, 0) + 1
+        bytes_[op] = bytes_.get(op, 0) + payload
+    return counts, bytes_
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--virtual-cpu", action="store_true")
+    parser.add_argument("--iters", type=int, default=20)
+    parser.add_argument("--dim", type=int, default=512)
+    parser.add_argument("--json", action="store_true")
+    args = parser.parse_args()
+
+    if args.virtual_cpu:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8").strip()
+
+    import jax
+    if args.virtual_cpu:
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    import bluefog_tpu as bf
+    from bluefog_tpu import models, schedule as sch
+    from bluefog_tpu import optimizers as bfopt
+    from bluefog_tpu import topology as tu
+
+    bf.init(platform="cpu" if args.virtual_cpu else None)
+    n = bf.size()
+    topo = tu.ExponentialTwoGraph(n)
+    bf.set_topology(topo, is_weighted=True)
+    dyn = sch.compile_dynamic_schedules(
+        lambda r: tu.GetDynamicOnePeerSendRecvRanks(topo, r), n)
+
+    D = args.dim
+    model = models.MLP(features=(2 * D, D, 10))
+    params = model.init(jax.random.key(0), jnp.ones((1, D)))
+    p_count = sum(x.size for x in jax.tree.leaves(params))
+
+    def grad_fn(p, batch):
+        xb, yb = batch
+
+        def loss(q):
+            return optax.softmax_cross_entropy_with_integer_labels(
+                model.apply(q, xb), yb).mean()
+
+        return jax.value_and_grad(loss)(p)
+
+    opt = lambda: optax.sgd(0.05, momentum=0.9)
+    strategies = {
+        "allreduce": lambda: bfopt.gradient_allreduce(opt()),
+        "neighbor (CTA)": lambda: bfopt.adapt_with_combine(
+            opt(), bfopt.neighbor_communicator(bf.static_schedule())),
+        "neighbor (ATC)": lambda: bfopt.adapt_then_combine(
+            opt(), bfopt.neighbor_communicator(bf.static_schedule())),
+        "dynamic one-peer": lambda: bfopt.adapt_with_combine(
+            opt(), bfopt.neighbor_communicator(schedules=dyn)),
+        "win_put": lambda: bfopt.win_put_optimizer(opt()),
+        "push_sum": lambda: bfopt.push_sum(opt()),
+        "zero-1 allreduce": lambda: bfopt.zero_gradient_allreduce(opt()),
+        "choco (int8 wire)": lambda: bfopt.choco_gossip(opt()),
+        "neighbor bf16 wire": lambda: bfopt.adapt_with_combine(
+            opt(), bfopt.neighbor_communicator(bf.static_schedule(),
+                                               wire="bf16")),
+    }
+
+    rng = np.random.default_rng(0)
+    batch = (jnp.asarray(rng.normal(size=(n, 16, D)), jnp.float32),
+             jnp.zeros((n, 16), jnp.int32))
+
+    # abstract TPU target for the bytes column (the schedule that matters)
+    tpu_mesh = None
+    try:
+        from jax.experimental import topologies
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        td = topologies.get_topology_desc("v5e:2x4", platform="tpu")
+        if len(td.devices) == n:
+            tpu_mesh = Mesh(np.array(td.devices), ("rank",))
+        else:
+            print(f"# TPU AOT target has {len(td.devices)} devices but "
+                  f"n={n}; wire bytes are the current backend's HLO "
+                  "(bf16 wire may show full width)", file=sys.stderr)
+    except Exception as e:                              # noqa: BLE001
+        print(f"# no TPU AOT target ({type(e).__name__}); wire bytes are "
+              "the current backend's HLO", file=sys.stderr)
+
+    def aot_wire(strategy, dist_params, dist_state):
+        def per_rank(p, s, b):
+            p, s, b = jax.tree.map(lambda t: t[0], (p, s, b))
+            _, grads = grad_fn(p, b)
+            new_p, new_s = strategy.update(grads, s, p)
+            return jax.tree.map(lambda t: t[None], (new_p, new_s))
+
+        fn = jax.jit(jax.shard_map(
+            per_rank, mesh=tpu_mesh, in_specs=(P("rank"),) * 3,
+            out_specs=(P("rank"),) * 2))
+        sds = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(
+                x.shape, x.dtype,
+                sharding=NamedSharding(tpu_mesh, P("rank"))),
+            (dist_params, dist_state, batch))
+        return wire_stats(fn.lower(*sds).compile().as_text())
+
+    rows = []
+    for name, make in strategies.items():
+        strategy = make()
+        dist_params = bfopt.replicate(params, n)
+        dist_state = bfopt.init_distributed(strategy, dist_params)
+        step = bfopt.make_train_step(grad_fn, strategy)
+        compiled = step.lower(dist_params, dist_state, batch).compile()
+        if tpu_mesh is not None:
+            counts, bytes_ = aot_wire(strategy, dist_params, dist_state)
+        else:
+            counts, bytes_ = wire_stats(compiled.as_text())
+        wire_mib = sum(bytes_.values()) / 2 ** 20
+        fn = compiled
+        ps, st, loss = fn(dist_params, dist_state, batch)
+        bf.hard_sync(loss)
+        t0 = time.perf_counter()
+        for _ in range(args.iters):
+            ps, st, loss = fn(ps, st, batch)
+        bf.hard_sync(loss)
+        ms = (time.perf_counter() - t0) / args.iters * 1e3
+        coll = ", ".join(f"{k.replace('collective-', '')}x{v}"
+                         for k, v in sorted(counts.items())) or "none"
+        rows.append((name, coll, wire_mib, ms))
+
+    param_mib = p_count * 4 / 2 ** 20
+    if args.json:
+        import json
+        for name, coll, mib, ms in rows:
+            executed = mib / len(dyn) if "dynamic" in name else mib
+            print(json.dumps({"strategy": name, "collectives": coll,
+                              "wire_mib_per_step_per_chip": round(executed, 3),
+                              "ms_per_step": round(ms, 2)}))
+        return
+    print(f"# {n} ranks, Exp2 topology, MLP {p_count:,} params "
+          f"({param_mib:.1f} MiB f32), batch 16/rank")
+    print(f"{'strategy':<20} {'collectives (per step)':<34} "
+          f"{'wire MiB/chip':>13} {'ms/step':>9}")
+    for name, coll, mib, ms in rows:
+        note = ""
+        if "dynamic" in name:
+            # static HLO text carries every lax.switch branch; exactly one
+            # permute round executes per step
+            note = f"  († executes 1 of {len(dyn)} branches/step: "
+            note += f"{mib / len(dyn):.2f} MiB)"
+        print(f"{name:<20} {coll:<34} {mib:>13.2f} {ms:>9.2f}{note}")
+
+
+if __name__ == "__main__":
+    main()

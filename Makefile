@@ -44,6 +44,8 @@ test_examples:
 	$(PY) examples/mnist.py --virtual-cpu --epochs 1 --dynamic-topology --atc
 	$(PY) examples/resnet.py --virtual-cpu --epochs 1 --warmup-epochs 0 \
 		--train-size 256 --batch-size 8
+	$(PY) examples/haiku_mnist.py --virtual-cpu --epochs 1
+	$(PY) examples/torch_migration.py --virtual-cpu --epochs 1
 	$(PY) examples/long_context.py --virtual-cpu --steps 10
 	$(PY) examples/long_context.py --virtual-cpu --steps 10 \
 		--sp-layout zigzag --rope

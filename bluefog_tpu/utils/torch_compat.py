@@ -18,7 +18,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["from_torch", "to_torch"]
+__all__ = ["from_torch", "to_torch", "conv_kernel", "linear_kernel",
+           "flatten_kernel", "conv_kernel_to_torch", "linear_kernel_to_torch",
+           "flatten_kernel_to_torch"]
 
 
 def from_torch(state_dict: Mapping[str, Any], *, dtype=None) -> Dict[str, Any]:
@@ -38,6 +40,52 @@ def from_torch(state_dict: Mapping[str, Any], *, dtype=None) -> Dict[str, Any]:
             node = node.setdefault(p, {})
         node[parts[-1]] = leaf
     return tree
+
+
+def conv_kernel(w) -> jax.Array:
+    """torch ``Conv2d.weight`` ``[O, I, kH, kW]`` -> flax ``[kH, kW, I, O]``.
+
+    The two frameworks disagree on both image layout (NCHW vs NHWC) and
+    kernel layout; weight values are identical, only axes move.
+    """
+    return jnp.transpose(jnp.asarray(w), (2, 3, 1, 0))
+
+
+def linear_kernel(w) -> jax.Array:
+    """torch ``Linear.weight`` ``[O, I]`` -> flax ``Dense`` kernel ``[I, O]``."""
+    return jnp.asarray(w).T
+
+
+def flatten_kernel(w, chw: tuple) -> jax.Array:
+    """torch Linear-after-flatten weight -> flax Dense-after-flatten kernel.
+
+    The subtle one: flattening a feature map orders elements ``(C, H, W)``
+    under torch's NCHW but ``(H, W, C)`` under NHWC, so the fc kernel's input
+    axis must be re-ordered, not just transposed.  ``chw`` is the torch-side
+    feature-map shape ``(C, H, W)`` entering the flatten.
+    """
+    c, h, wd = chw
+    w = jnp.asarray(w)                       # [O, C*H*W]
+    return jnp.transpose(
+        w.reshape((-1, c, h, wd)), (2, 3, 1, 0)).reshape((h * wd * c, -1))
+
+
+def conv_kernel_to_torch(k):
+    """Inverse of :func:`conv_kernel`: flax ``[kH, kW, I, O]`` -> ``[O, I, kH, kW]``."""
+    return jnp.transpose(jnp.asarray(k), (3, 2, 0, 1))
+
+
+def linear_kernel_to_torch(k):
+    """Inverse of :func:`linear_kernel`."""
+    return jnp.asarray(k).T
+
+
+def flatten_kernel_to_torch(k, chw: tuple):
+    """Inverse of :func:`flatten_kernel` (``chw`` = torch-side ``(C, H, W)``)."""
+    c, h, wd = chw
+    k = jnp.asarray(k)                       # [H*W*C, O]
+    return jnp.transpose(
+        k.reshape((h, wd, c, -1)), (3, 2, 0, 1)).reshape((-1, c * h * wd))
 
 
 def to_torch(tree: Any) -> "Dict[str, Any]":

@@ -26,3 +26,59 @@ def test_dtype_override():
     sd = {"w": torch.ones(3, 3, dtype=torch.float64)}
     tree = torch_compat.from_torch(sd, dtype=jnp.bfloat16)
     assert tree["w"].dtype == jnp.bfloat16
+
+
+class TestLayoutHelpers:
+    """The kernel-layout converters produce numerically identical layers:
+    torch NCHW forward == flax NHWC forward through the converted weights
+    (the whole point of the migration path, examples/torch_migration.py)."""
+
+    def test_conv_kernel_matches_torch_conv(self):
+        import jax.numpy as jnp
+        from jax import lax
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(2, 1, 8, 8)).astype(np.float32)    # NCHW
+        conv = torch.nn.Conv2d(1, 3, 3)
+        with torch.no_grad():
+            ref = conv(torch.from_numpy(x)).numpy()             # [2,3,6,6]
+        k = torch_compat.conv_kernel(conv.weight.detach().numpy())
+        out = lax.conv_general_dilated(
+            jnp.asarray(np.transpose(x, (0, 2, 3, 1))), k, (1, 1), "VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        out = out + jnp.asarray(conv.bias.detach().numpy())
+        np.testing.assert_allclose(
+            np.transpose(np.asarray(out), (0, 3, 1, 2)), ref,
+            rtol=1e-4, atol=1e-5)
+
+    def test_flatten_kernel_matches_torch_fc(self):
+        """NCHW flattens (C,H,W), NHWC flattens (H,W,C): the fc-after-
+        flatten kernel must reorder its input axis, not just transpose."""
+        import jax.numpy as jnp
+        rng = np.random.default_rng(1)
+        c, h, w = 3, 4, 5
+        feat = rng.normal(size=(2, c, h, w)).astype(np.float32)  # NCHW
+        fc = torch.nn.Linear(c * h * w, 7)
+        with torch.no_grad():
+            ref = fc(torch.from_numpy(feat).flatten(1)).numpy()
+        k = torch_compat.flatten_kernel(fc.weight.detach().numpy(),
+                                        chw=(c, h, w))
+        nhwc_flat = jnp.asarray(
+            np.transpose(feat, (0, 2, 3, 1)).reshape(2, -1))
+        out = nhwc_flat @ k + jnp.asarray(fc.bias.detach().numpy())
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4, atol=1e-5)
+
+    def test_inverses_round_trip(self):
+        rng = np.random.default_rng(2)
+        conv_w = rng.normal(size=(6, 3, 3, 3)).astype(np.float32)
+        lin_w = rng.normal(size=(7, 11)).astype(np.float32)
+        fc_w = rng.normal(size=(7, 3 * 4 * 5)).astype(np.float32)
+        np.testing.assert_array_equal(
+            np.asarray(torch_compat.conv_kernel_to_torch(
+                torch_compat.conv_kernel(conv_w))), conv_w)
+        np.testing.assert_array_equal(
+            np.asarray(torch_compat.linear_kernel_to_torch(
+                torch_compat.linear_kernel(lin_w))), lin_w)
+        np.testing.assert_array_equal(
+            np.asarray(torch_compat.flatten_kernel_to_torch(
+                torch_compat.flatten_kernel(fc_w, chw=(3, 4, 5)),
+                chw=(3, 4, 5))), fc_w)

@@ -8,7 +8,16 @@ synchronization barrier is broken (see ``bf.hard_sync`` — on the axon PJRT
 plugin ``block_until_ready`` returns at dispatch, which once produced a
 "28 PFLOP/s matmul" here).
 
-Run:  python tools/chip_calibrate.py        (single client on the tunnel)
+Each probe loops its body inside ONE compiled program (``lax.scan``), so a
+single host->device dispatch covers the whole timed region: round-2's
+per-dispatch HBM probe measured 307 GB/s on an 819 GB/s part because ~ms of
+tunnel dispatch latency was charged to every 1 GiB copy.  The per-dispatch
+variant is still measured alongside — the DIFFERENCE is the per-call
+dispatch overhead, the number that justifies ``steps_per_call`` batching in
+bench.py.
+
+Run:  python tools/chip_calibrate.py          (single client on the tunnel)
+      python tools/chip_calibrate.py --smoke  (tiny shapes, any backend)
 Prints one JSON line per probe.
 """
 import json
@@ -17,40 +26,76 @@ import time
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 sys.path.insert(0, ".")
 from bluefog_tpu.api import hard_sync  # noqa: E402
 
 
+def _timed(f, x):
+    """Seconds for one dispatch of compiled ``f`` (hard_sync barrier)."""
+    t0 = time.perf_counter()
+    hard_sync(f(x))
+    return time.perf_counter() - t0
+
+
+def _scanned(body, x, iters):
+    """One-dispatch seconds-per-iteration of ``body`` via lax.scan."""
+    f = jax.jit(lambda x0: lax.scan(
+        lambda c, _: (body(c), None), x0, None, length=iters)[0])
+    hard_sync(f(x))                       # compile + warm
+    return _timed(f, x) / iters
+
+
+def _dispatched(body, x, iters):
+    """Per-iteration seconds with one host dispatch per call (the naive
+    loop); the gap vs _scanned is the per-dispatch overhead."""
+    f = jax.jit(body)
+    y = hard_sync(f(x))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        y = f(y)                          # chained: no overlap ambiguity
+    hard_sync(y)
+    return (time.perf_counter() - t0) / iters
+
+
 def main():
+    smoke = "--smoke" in sys.argv
+    if smoke:
+        # the axon plugin force-sets jax_platforms at interpreter boot,
+        # overriding the env var — without this a CI smoke dials the tunnel
+        jax.config.update("jax_platforms", "cpu")
     d = jax.devices()[0]
     print(json.dumps({"probe": "device", "kind": d.device_kind,
                       "platform": d.platform}))
 
-    for n in (4096, 8192):
-        a = jnp.ones((n, n), jnp.bfloat16)
-        f = jax.jit(lambda a, b: a @ b)
-        c = hard_sync(f(a, a))
-        iters = 50
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            c = f(a, c)           # chained: no inter-call overlap ambiguity
-        hard_sync(c)
-        dt = (time.perf_counter() - t0) / iters
+    mm_sizes = (256,) if smoke else (4096, 8192)
+    iters = 5 if smoke else 50
+    for n in mm_sizes:
+        # rows of a sum to 1 => the scan carry stays O(1) (no bf16 overflow
+        # across 50 chained matmuls)
+        a = jnp.full((n, n), 1.0 / n, jnp.bfloat16)
+        per_scan = _scanned(lambda c: a @ c, a, iters)
+        per_call = _dispatched(lambda c: a @ c, a, iters)
         print(json.dumps({
-            "probe": f"matmul_bf16_{n}", "ms": round(dt * 1e3, 3),
-            "tflops": round(2 * n ** 3 / dt / 1e12, 1)}))
+            "probe": f"matmul_bf16_{n}",
+            "ms": round(per_scan * 1e3, 3),
+            "tflops": round(2 * n ** 3 / per_scan / 1e12, 1),
+            "per_dispatch_ms": round(per_call * 1e3, 3),
+            "dispatch_overhead_ms": round((per_call - per_scan) * 1e3, 3)}))
 
-    x = jnp.ones((2 ** 28,), jnp.float32)          # 1 GiB
-    g = jax.jit(lambda x: x * 1.0001)
-    y = hard_sync(g(x))
-    t0 = time.perf_counter()
-    for _ in range(20):
-        y = g(y)
-    hard_sync(y)
-    dt = (time.perf_counter() - t0) / 20
-    print(json.dumps({"probe": "hbm_rw_1GiB", "ms": round(dt * 1e3, 3),
-                      "gbps": round(2 * 2 ** 30 / dt / 1e9)}))
+    hbm_sizes = (2 ** 20,) if smoke else (2 ** 27, 2 ** 28)   # 512MiB, 1GiB
+    for size in hbm_sizes:
+        x = jnp.ones((size,), jnp.float32)
+        bytes_per_iter = 2 * 4 * size                  # read + write, f32
+        per_scan = _scanned(lambda y: y * 1.0001, x, iters)
+        per_call = _dispatched(lambda y: y * 1.0001, x, iters)
+        print(json.dumps({
+            "probe": f"hbm_rw_{4 * size // 2 ** 20}MiB",
+            "ms": round(per_scan * 1e3, 3),
+            "gbps": round(bytes_per_iter / per_scan / 1e9),
+            "per_dispatch_gbps": round(bytes_per_iter / per_call / 1e9),
+            "dispatch_overhead_ms": round((per_call - per_scan) * 1e3, 3)}))
 
 
 if __name__ == "__main__":

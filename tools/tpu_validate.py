@@ -143,10 +143,11 @@ def bench_kernel(B, T, H, D, block_q):
 
 
 def check_ring_single_device():
-    """ring_attention with use_pallas on a 1-chip mesh: fwd + grads."""
+    """ring_attention with use_pallas on a 1-chip mesh: fwd + grads, plus
+    the GQA (compact kv) and zigzag-layout paths vs the dense oracle."""
     from jax.sharding import PartitionSpec as P
     import bluefog_tpu as bf
-    from bluefog_tpu.ops import ring_attention
+    from bluefog_tpu.ops import ring_attention, zigzag_order, zigzag_inverse
 
     bf.init()
     try:
@@ -161,20 +162,64 @@ def check_ring_single_device():
             return jax.lax.psum(jnp.sum(out ** 2), "rank"), out
 
         g = jax.value_and_grad(loss, argnums=(0, 1, 2), has_aux=True)
+        # check_vma=False: the pallas kernel's scalar chunk offsets are
+        # unvarying beside rank-varying blocks (known jax VMA false positive;
+        # same workaround as tests/test_ring.py)
         fn = jax.jit(jax.shard_map(
             g, mesh=bf.mesh(), in_specs=(P(None, "rank"),) * 3,
-            out_specs=((P(), P(None, "rank")), (P(None, "rank"),) * 3)))
+            out_specs=((P(), P(None, "rank")), (P(None, "rank"),) * 3),
+            check_vma=False))
         (_, out), grads = fn(q, k, v)
         expected = dense_oracle(q, k, v, True, 1.0 / np.sqrt(D))
         err = float(np.max(np.abs(np.asarray(out) - expected)))
         finite = all(bool(np.all(np.isfinite(np.asarray(x)))) for x in grads)
         report("ring_attention_pallas_1chip", err < 1e-4 and finite,
                max_abs_err=err, grads_finite=finite, shape=[B, T, H, D])
+
+        # GQA: 8 q heads sharing 2 kv heads (4x fewer ring bytes); oracle is
+        # dense attention with the kv heads repeated per group
+        Hq, Hkv = 8, 2
+        qg = jnp.asarray(rng.normal(size=(B, T, Hq, D)), jnp.float32)
+        kg, vg = (jnp.asarray(rng.normal(size=(B, T, Hkv, D)), jnp.float32)
+                  for _ in range(2))
+        gqa_fn = jax.jit(jax.shard_map(
+            lambda a, b, c: ring_attention(a, b, c, axis="rank", causal=True,
+                                           use_pallas=True),
+            mesh=bf.mesh(), in_specs=(P(None, "rank"),) * 3,
+            out_specs=P(None, "rank"), check_vma=False))
+        out_g = gqa_fn(qg, kg, vg)
+        rep = Hq // Hkv
+        exp_g = dense_oracle(qg, np.repeat(np.asarray(kg), rep, axis=2),
+                             np.repeat(np.asarray(vg), rep, axis=2),
+                             True, 1.0 / np.sqrt(D))
+        err_g = float(np.max(np.abs(np.asarray(out_g) - exp_g)))
+        report("ring_attention_pallas_gqa", err_g < 1e-4, max_abs_err=err_g,
+               q_heads=Hq, kv_heads=Hkv)
+
+        # zigzag (balanced causal) layout through the Pallas path: feed the
+        # zigzag-permuted sequence, un-permute, compare to the dense oracle
+        n = bf.size()
+        order = zigzag_order(n, T)
+        inv = zigzag_inverse(n, T)
+        zz_fn = jax.jit(jax.shard_map(
+            lambda a, b, c: ring_attention(a, b, c, axis="rank", causal=True,
+                                           layout="zigzag", use_pallas=True),
+            mesh=bf.mesh(), in_specs=(P(None, "rank"),) * 3,
+            out_specs=P(None, "rank"), check_vma=False))
+        out_z = np.asarray(zz_fn(q[:, order], k[:, order], v[:, order]))
+        err_z = float(np.max(np.abs(out_z[:, inv] - expected)))
+        report("ring_attention_pallas_zigzag", err_z < 1e-4,
+               max_abs_err=err_z, shape=[B, T, H, D])
     finally:
         bf.shutdown()
 
 
 def main():
+    out_path = None
+    for i, a in enumerate(sys.argv):
+        if a == "--out" and i + 1 < len(sys.argv):
+            out_path = sys.argv[i + 1]
+
     dev = jax.devices()[0]
     if dev.platform == "cpu":
         print("refusing: no accelerator", file=sys.stderr)
@@ -190,8 +235,14 @@ def main():
     check_ring_single_device()
 
     ok = all(r["ok"] for r in RESULTS)
-    print(json.dumps({"summary": "PASS" if ok else "FAIL",
-                      "n_checks": len(RESULTS)}))
+    summary = {"summary": "PASS" if ok else "FAIL", "n_checks": len(RESULTS)}
+    print(json.dumps(summary))
+    if out_path:
+        import os
+        os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
+        with open(out_path, "w") as f:
+            json.dump({"device": dev.device_kind, "results": RESULTS,
+                       **summary}, f, indent=1)
     sys.exit(0 if ok else 1)
 
 

@@ -51,6 +51,8 @@ test_examples:
 		--sp-layout zigzag --rope
 	$(PY) examples/moe.py --virtual-cpu --steps 20
 	$(PY) examples/pipeline_lm.py --virtual-cpu --steps 30
+	$(PY) examples/pipeline_lm.py --virtual-cpu --steps 30 --interleaved 2 \
+		--micro 4
 
 # build the native (C++) components explicitly (otherwise built lazily)
 native:

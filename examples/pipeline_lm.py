@@ -40,6 +40,10 @@ def main():
     parser.add_argument("--lr", type=float, default=3e-3)
     parser.add_argument("--remat", action="store_true",
                         help="recompute stage forwards in the backward")
+    parser.add_argument("--interleaved", type=int, default=1, metavar="V",
+                        help="virtual chunks per device (V>1: Megatron-style "
+                             "interleaved ring schedule, ~V-fold smaller "
+                             "bubble; requires --micro <= --stages)")
     parser.add_argument("--seed", type=int, default=0)
     args = parser.parse_args()
     if args.lag < 1:
@@ -48,6 +52,9 @@ def main():
     if args.d_model % args.heads:
         parser.error(f"--d-model {args.d_model} must be divisible by "
                      f"--heads {args.heads}")
+    if args.interleaved > 1 and args.micro > args.stages:
+        parser.error("interleaved schedule needs --micro <= --stages "
+                     "(stream bigger batches in groups of S)")
 
     if args.virtual_cpu:
         flags = os.environ.get("XLA_FLAGS", "")
@@ -62,7 +69,8 @@ def main():
     import numpy as np
     import optax
     from jax.sharding import Mesh, PartitionSpec as P
-    from bluefog_tpu.parallel.pipeline import last_stage_value, pipeline_apply
+    from bluefog_tpu.parallel.pipeline import (
+        last_stage_value, pipeline_apply, pipeline_interleaved_apply)
 
     S, M, T, D, H = args.stages, args.micro, args.seq_len, args.d_model, args.heads
     B, vocab = 2, 32
@@ -78,24 +86,32 @@ def main():
         return {"wqkv": w(D, 3 * D), "wo": w(D, D),
                 "w1": w(D, 4 * D), "w2": w(4 * D, D)}
 
+    V = args.interleaved
+    blocks = [init_block() for _ in range(S * V)]
+    if V == 1:
+        stage_params = jax.tree.map(lambda *xs: jnp.stack(xs), *blocks)
+    else:
+        # Megatron placement: device d holds chunks k as virtual stage k*S+d
+        # -> leaves [S, V, ...] with chunked[d][k] = blocks[k*S + d]
+        stage_params = jax.tree.map(
+            lambda *xs: jnp.moveaxis(
+                jnp.stack(xs).reshape((V, S) + xs[0].shape), 1, 0), *blocks)
     params = {
         "embed": jnp.asarray(rng.normal(size=(vocab, D)) * 0.1, jnp.float32),
         "pos": jnp.asarray(rng.normal(size=(T, D)) * 0.1, jnp.float32),
         "head": jnp.asarray(rng.normal(size=(D, vocab)) * 0.1, jnp.float32),
-        "stage": jax.tree.map(lambda *xs: jnp.stack(xs),
-                              *[init_block() for _ in range(S)]),
+        "stage": stage_params,
     }
 
     def ln(z):
         mu = z.mean(-1, keepdims=True)
         return (z - mu) / jnp.sqrt(z.var(-1, keepdims=True) + 1e-6)
 
-    def stage_fn(p, x):
-        # one pre-LN decoder block; x: [B, T, D] (p leaves carry the
-        # stage-shard leading axis of size 1)
+    def block_fn(p, x):
+        # one pre-LN decoder block; x: [B, T, D]; p: one block's weights
         hsz = D // H
         h = ln(x)
-        qkv = h @ p["wqkv"][0]
+        qkv = h @ p["wqkv"]
         q, k, v = jnp.split(qkv, 3, axis=-1)
         q = q.reshape(B, T, H, hsz)
         k = k.reshape(B, T, H, hsz)
@@ -105,16 +121,25 @@ def main():
         s = jnp.where(mask[None, None], s, -jnp.inf)
         a = jax.nn.softmax(s, axis=-1)
         att = jnp.einsum("bhij,bjhd->bihd", a, v).reshape(B, T, D)
-        x = x + att @ p["wo"][0]
+        x = x + att @ p["wo"]
         h = ln(x)
-        return x + jax.nn.gelu(h @ p["w1"][0]) @ p["w2"][0]
+        return x + jax.nn.gelu(h @ p["w1"]) @ p["w2"]
+
+    def stage_fn(p, x):
+        # GPipe path: p leaves carry the stage-shard leading axis of size 1
+        return block_fn(jax.tree.map(lambda t: t[0], p), x)
 
     def loss_fn(params, tokens, targets):
         # tokens/targets: [M, B, T]; embed on every stage (replicated math),
         # only stage 0's copy feeds the pipeline
         emb = params["embed"][tokens] + params["pos"][None, None]
-        out = pipeline_apply(stage_fn, params["stage"], emb, axis="stage",
-                             remat=args.remat)
+        if V > 1:
+            local = jax.tree.map(lambda t: t[0], params["stage"])  # [V, ...]
+            out = pipeline_interleaved_apply(
+                block_fn, local, emb, axis="stage", remat=args.remat)
+        else:
+            out = pipeline_apply(stage_fn, params["stage"], emb, axis="stage",
+                                 remat=args.remat)
         out = last_stage_value(out, axis="stage")
         logits = ln(out) @ params["head"]
         mask = (targets >= 0).astype(jnp.float32)
@@ -156,11 +181,13 @@ def main():
         losses.append(float(jax.block_until_ready(loss)[0]))
         if it % 20 == 0 or it == args.steps - 1:
             print(f"step {it}: loss {losses[-1]:.4f} "
-                  f"({S} stages x {M} microbatches)")
+                  f"({S} stages x {M} microbatches"
+                  f"{f' x {V} chunks' if V > 1 else ''})")
 
     assert losses[-1] < losses[0], "no training progress through stages"
     print(f"[pipeline] loss {losses[0]:.3f} -> {losses[-1]:.3f} over "
           f"{S} stages ({M} microbatches/step"
+          f"{f', interleaved V={V}' if V > 1 else ''}"
           f"{', remat' if args.remat else ''})")
 
 

@@ -1,0 +1,92 @@
+"""Dispatch-amortization sweep + step trace for the headline benchmark.
+
+Runs bench.py's exact measurement (``bench.run_bench``) at several
+``steps_per_call`` values on the attached accelerator, showing how scanning
+K optimizer steps into one compiled program amortizes the host->device
+dispatch cost (the per-call overhead measured by ``tools/chip_calibrate.py``).
+Optionally captures a profiler trace of the steady-state step for the
+compute/comm/host attribution in docs/PERFORMANCE.md.
+
+Run (single tunnel client):
+    python tools/step_sweep.py [--trace /tmp/bench_trace] \
+        [--out docs/measured/step_sweep_r03.json]
+"""
+import argparse
+import importlib.util
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--sweep", default="1,2,5,10",
+                        help="comma-separated steps_per_call values")
+    parser.add_argument("--batch", type=int, default=64)
+    parser.add_argument("--iters", type=int, default=10)
+    parser.add_argument("--trace", default=None,
+                        help="directory for a jax.profiler trace of the "
+                             "largest steps_per_call run")
+    parser.add_argument("--out", default=None, help="json artifact path")
+    parser.add_argument("--allow-cpu", action="store_true")
+    args = parser.parse_args()
+
+    import jax
+
+    if args.allow_cpu:
+        # the axon plugin force-sets jax_platforms at boot; without this a
+        # CPU smoke dials the TPU tunnel
+        jax.config.update("jax_platforms", "cpu")
+    dev = jax.devices()[0]
+    if dev.platform == "cpu" and not args.allow_cpu:
+        print("refusing: no accelerator (pass --allow-cpu to force)",
+              file=sys.stderr)
+        sys.exit(2)
+
+    spec = importlib.util.spec_from_file_location(
+        "bench", os.path.join(os.path.dirname(__file__), os.pardir,
+                              "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+
+    sweep = [int(s) for s in args.sweep.split(",")]
+    on_accel = dev.platform != "cpu"
+    os.environ["BLUEFOG_BENCH_BATCH"] = str(args.batch)
+    os.environ["BLUEFOG_BENCH_ITERS"] = str(args.iters)
+
+    rows = []
+    for i, spc in enumerate(sorted(sweep)):
+        os.environ["BLUEFOG_BENCH_STEPS_PER_CALL"] = str(spc)
+        tracing = args.trace and spc == max(sweep)
+        if tracing:
+            jax.profiler.start_trace(args.trace)
+        r = bench.run_bench(on_accel, {"sweep_index": i})
+        if tracing:
+            jax.profiler.stop_trace()
+        row = {"steps_per_call": spc, "imgs_per_sec_per_chip": r["value"],
+               "mfu": r["mfu"]}
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+
+    base = rows[0]["imgs_per_sec_per_chip"]
+    for row in rows:
+        row["vs_spc1"] = round(row["imgs_per_sec_per_chip"] / base, 3)
+    summary = {"device": dev.device_kind, "batch": args.batch,
+               "rows": rows,
+               "dispatch_amortization":
+                   round(max(r["imgs_per_sec_per_chip"] for r in rows)
+                         / base, 3)}
+    print(json.dumps({"summary": summary["dispatch_amortization"],
+                      "best": max(rows,
+                                  key=lambda r: r["imgs_per_sec_per_chip"])}))
+    if args.out:
+        os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+        with open(args.out, "w") as f:
+            json.dump(summary, f, indent=1)
+        print(f"wrote {args.out}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -69,6 +69,26 @@ def test_bench_script_both_steps_axis_contracts(steps_per_call):
     assert out["probe_attempts"] == 3           # probe telemetry passes through
 
 
+def test_run_bench_accelerator_branch_on_virtual_mesh(monkeypatch):
+    """The on_accelerator=True code path (scan of 5 steps/call, no CPU
+    override) — the branch the graded TPU run takes — exercised on the
+    conftest mesh, where the platform is already pinned to CPU."""
+    spec = importlib.util.spec_from_file_location("bench", _BENCH)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    monkeypatch.setenv("BLUEFOG_BENCH_BATCH", "1")
+    monkeypatch.setenv("BLUEFOG_BENCH_ITERS", "1")
+    monkeypatch.setenv("BLUEFOG_BENCH_IMAGE_SIZE", "32")
+    monkeypatch.setenv("BLUEFOG_BENCH_CLASSES", "10")
+    monkeypatch.delenv("BLUEFOG_BENCH_STEPS_PER_CALL", raising=False)
+    result = mod.run_bench(True, {"probe_attempts": 1})
+    assert result["on_accelerator"] is True
+    assert result["steps_per_call"] == 5      # the accelerator default
+    assert result["value"] > 0
+    assert result["mfu"] is None              # no peak table entry for cpu
+
+
 def test_run_bench_in_process_on_virtual_mesh(monkeypatch):
     """run_bench on the conftest's 8-device mesh: covers the n>1 branch
     (topology + batch broadcast) that the 1-device subprocess runs skip."""

@@ -85,6 +85,15 @@ def ring_attention(
     ``jax.default_backend()``): pass ``False`` when AOT-compiling for a TPU
     topology from a CPU host, where the default backend is not the target.
 
+    .. warning:: the Pallas path needs ``check_vma=False`` on the enclosing
+       ``shard_map`` (its grid bookkeeping mixes varying/unvarying
+       operands).  With VMA checking off, ``psum``/``pmean`` transpose as a
+       cotangent *sum*, so a collective inside a differentiated loss
+       over-counts gradients by the axis size.  Keep the differentiated
+       scalar collective-free and psum grads/loss AFTER ``value_and_grad``
+       (the pattern in ``examples/long_context.py`` and
+       ``tests/test_compose.py``).
+
     ``layout="zigzag"`` (causal only) expects the sequence sharded in the
     *balanced* order (:func:`zigzag_order`): device i holds chunks
     ``(i, 2n-1-i)``, so every device computes exactly two chunk-pair

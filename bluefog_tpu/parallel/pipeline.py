@@ -40,6 +40,27 @@ __all__ = ["pipeline_apply", "last_stage_value", "pipeline_1f1b_grad",
 Axis = str
 
 
+def _vary(z: jax.Array, axis: Axis, *likes) -> jax.Array:
+    """pcast ``z`` varying over ``axis`` AND every mesh axis any leaf of
+    ``likes`` already varies over: on a multi-axis mesh (e.g. stage x rank
+    with per-rank microbatches or per-rank decentralized params) the scan
+    carry must match the computation's full varying set or the carry types
+    diverge under VMA checking."""
+    need = {axis}
+    for like in likes:
+        for leaf in jax.tree.leaves(like):
+            try:
+                need |= set(jax.typeof(leaf).vma)
+            except (AttributeError, TypeError):
+                pass
+    for ax in sorted(need):
+        try:
+            z = lax.pcast(z, ax, to='varying')
+        except ValueError:
+            pass                     # already varying over ax
+    return z
+
+
 def pipeline_apply(
     stage_fn: Callable[[Any, jax.Array], jax.Array],
     stage_params: Any,
@@ -100,12 +121,14 @@ def pipeline_apply(
         return (inbox, outputs), None
 
     # pcast: the carries become varying over the stage axis after the first
-    # permute/indexed write, so the scan carry type must start varying too
-    inbox0 = lax.pcast(
-        jnp.zeros(act_shape, microbatches.dtype), axis, to='varying')
-    outputs0 = lax.pcast(
+    # permute/indexed write (and over any axis the microbatches vary on),
+    # so the scan carry type must start with the same varying set
+    inbox0 = _vary(
+        jnp.zeros(act_shape, microbatches.dtype), axis, microbatches,
+        stage_params)
+    outputs0 = _vary(
         jnp.zeros((num_micro,) + act_shape, microbatches.dtype), axis,
-        to='varying')
+        microbatches, stage_params)
     (_, outputs), _ = lax.scan(
         tick, (inbox0, outputs0), jnp.arange(ticks))
     return outputs
@@ -209,7 +232,7 @@ def pipeline_1f1b_grad(
         stash, fwd_inbox = fwd_tick(t, stage_params, stash, fwd_inbox)
         return (stash, fwd_inbox, bwd_inbox, dparams, loss_acc), None
 
-    vary = lambda x: lax.pcast(x, axis, to='varying')
+    vary = lambda x: _vary(x, axis, microbatches, stage_params)
     carry0 = (
         vary(jnp.zeros((buf,) + act_shape, act_dtype)),          # stash
         vary(jnp.zeros(act_shape, act_dtype)),                   # fwd inbox
@@ -313,9 +336,10 @@ def pipeline_interleaved_apply(
         inbox = lax.ppermute(y, axis, perm=ring)
         return (inbox, outputs), None
 
-    vary = lambda z: lax.pcast(z, axis, to='varying')
-    carry0 = (vary(jnp.zeros(act_shape, microbatches.dtype)),
-              vary(jnp.zeros((M,) + act_shape, microbatches.dtype)))
+    carry0 = (_vary(jnp.zeros(act_shape, microbatches.dtype), axis,
+                    microbatches, chunk_params),
+              _vary(jnp.zeros((M,) + act_shape, microbatches.dtype), axis,
+                    microbatches, chunk_params))
     (_, outputs), _ = lax.scan(tick, carry0, jnp.arange(ticks))
     return outputs
 

@@ -83,6 +83,61 @@ def test_pipeline_by_ring_sp_matches_oracle(cpu_devices):
                                rtol=1e-4, atol=1e-5)
 
 
+def test_pipeline_by_gossip_dp_trains_to_consensus(cpu_devices):
+    """Decentralized DP x PP: each rank column holds its OWN params (and
+    data shard), stages pipeline along the stage axis, and a neighbor-
+    allreduce gossip step over rank mixes each stage's parameters — the
+    reference's decentralized training composed with a parallelism mode it
+    never had.  Loss must fall and the rank spread must tighten."""
+    from bluefog_tpu import schedule as sch
+    from bluefog_tpu import topology as tu
+    from bluefog_tpu.ops import collectives as C
+
+    rng = np.random.default_rng(2)
+    mesh = Mesh(np.array(cpu_devices[:S * R]).reshape(S, R), ("stage", "rank"))
+    sched = sch.compile_topology(tu.ExponentialTwoGraph(R), weighted=True)
+
+    # per-(stage, rank) params: decentralized starts differ per rank
+    w = jnp.asarray(rng.normal(size=(S, R, D, D)) * 0.4, jnp.float32)
+    # teacher: shared across ranks (the consensus target exists)
+    tw = jnp.asarray(rng.normal(size=(S, D, D)) * 0.4, jnp.float32)
+    x_all = jnp.asarray(rng.normal(size=(R, M, B, D)), jnp.float32)
+    y_all = x_all
+    for s in range(S):
+        y_all = jnp.tanh(y_all @ tw[s])
+
+    def stage_fn(p, x):
+        return jnp.tanh(x @ p)
+
+    def step(w, mbs, tgts):
+        sid = jax.lax.axis_index("stage")
+        local = w[0, 0]                                     # [D, D]
+
+        def loss(w_):
+            out = pipeline_apply(stage_fn, w_, mbs[0], axis="stage")
+            err = jnp.sum((out - tgts[0]) ** 2)
+            return jnp.where(sid == S - 1, err, 0.0) / (M * B * D)
+
+        l, g = jax.value_and_grad(loss)(local)
+        new = local - 0.3 * g
+        # gossip this stage's params across the rank axis (CTA combine)
+        new = C.neighbor_allreduce(new, sched, axis="rank")
+        return new[None, None], jax.lax.psum(l, ("stage", "rank"))[None, None]
+
+    fn = jax.jit(jax.shard_map(
+        step, mesh=mesh,
+        in_specs=(P("stage", "rank"), P("rank"), P("rank")),
+        out_specs=(P("stage", "rank"), P("stage", "rank"))))
+
+    losses = []
+    for _ in range(40):
+        w, l = fn(w, x_all, y_all)
+        losses.append(float(np.asarray(jax.block_until_ready(l))[0, 0]))
+    assert losses[-1] < 0.5 * losses[0], losses[::10]
+    spread = np.abs(np.asarray(w) - np.asarray(w).mean(axis=1, keepdims=True))
+    assert float(spread.max()) < 0.05, spread.max()     # ranks reached consensus
+
+
 def test_pipeline_by_ring_sp_grads_match_oracle(cpu_devices):
     rng = np.random.default_rng(1)
     params = _params(rng, S)

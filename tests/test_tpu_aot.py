@@ -339,6 +339,73 @@ def test_ulysses_kernels_lower_for_tpu(tpu_mesh):
     assert "all-to-all" in txt                    # the head/seq re-shard
 
 
+def test_strategy_comm_patterns_on_tpu_schedule(tpu_mesh):
+    """Every strategy's cross-chip traffic, pinned: the compiled v5e step
+    carries exactly the collectives the design promises (counts + payload
+    dtypes).  Guards the whole optimizer surface against a silent comm
+    regression (e.g. a fusion change splitting the permute chain, or a
+    codec upcast like the bf16-wire bug this round)."""
+    sched = sch.compile_topology(tu.ExponentialTwoGraph(N), weighted=True)
+    dyn = sch.compile_dynamic_schedules(
+        lambda r: tu.GetDynamicOnePeerSendRecvRanks(
+            tu.ExponentialTwoGraph(N), r), N)
+    opt = lambda: optax.sgd(0.05, momentum=0.9)
+    # strategy -> (async permute-starts in text, all-reduce count)
+    cases = {
+        "allreduce": (bfopt.gradient_allreduce(opt()), 0, 1),
+        "cta": (bfopt.adapt_with_combine(
+            opt(), bfopt.neighbor_communicator(sched)), 3, 0),
+        "atc": (bfopt.adapt_then_combine(
+            opt(), bfopt.neighbor_communicator(sched)), 3, 0),
+        # text carries every lax.switch branch (one executes per step)
+        "dynamic": (bfopt.adapt_with_combine(
+            opt(), bfopt.neighbor_communicator(schedules=dyn)), 3, 0),
+        "win_put": (bfopt.win_put_optimizer(opt(), sched), 3, 0),
+        "push_sum": (bfopt.push_sum(opt(), sched), 6, 0),   # value + P lane
+        "choco": (bfopt.choco_gossip(opt(), sched), 6, 0),  # diff + zero-self
+    }
+    dim = 64
+
+    def grad_fn(params, batch):
+        x, y = batch
+
+        def loss(p):
+            return jnp.mean((jnp.tanh(x @ p["w"]) - y) ** 2)
+
+        return jax.value_and_grad(loss)(params)
+
+    for name, (strat, n_permute, n_allreduce) in cases.items():
+        def per_rank(params, state, batch, strat=strat):
+            params, state, batch = jax.tree.map(
+                lambda t: t[0], (params, state, batch))
+            _, grads = grad_fn(params, batch)
+            params, state = strat.update(grads, state, params)
+            return jax.tree.map(lambda t: t[None], (params, state))
+
+        fn = jax.jit(jax.shard_map(
+            per_rank, mesh=tpu_mesh, in_specs=(P("rank"),) * 3,
+            out_specs=(P("rank"),) * 2))
+        params = {"w": jnp.zeros((N, dim, dim), jnp.float32)}
+        state0 = strat.init(jax.tree.map(lambda x: x[0], params))
+        state = jax.tree.map(
+            lambda x: jnp.broadcast_to(x, (N,) + x.shape), state0)
+        batch = tuple(jnp.zeros((N, 8, dim), jnp.float32) for _ in range(2))
+        sds = _sharded_sds((params, state, batch), tpu_mesh)
+        txt = fn.lower(*sds).compile().as_text()
+        starts = (_op_lines(txt, "collective-permute-start")
+                  + _op_lines(txt, "collective-permute"))
+        ars = (_op_lines(txt, "all-reduce-start")
+               + _op_lines(txt, "all-reduce"))
+        assert len(starts) == n_permute, (name, len(starts), n_permute)
+        assert len(ars) == n_allreduce, (name, len(ars), n_allreduce)
+        if name == "choco":       # int8 wire: s8 payloads, none full-width
+            lines = txt.splitlines()
+            assert sum(bool(re.search(r"s8\[", lines[i]))
+                       for i in starts) >= 3, name
+            assert not any(re.search(r"f32\[\d{4,}", lines[i])
+                           for i in starts), name
+
+
 def test_flagship_resnet_gossip_step_tpu_schedule(tpu_mesh):
     """The headline bench path (ResNet + neighbor-allreduce CTA, the shape
     bench.py builds) compiles for v5e with bf16 convolutions feeding the

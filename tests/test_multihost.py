@@ -58,6 +58,32 @@ CHILD = textwrap.dedent("""
                            for sh in bf.synchronize(loss).addressable_shards]))
         loss0 = l if loss0 is None else loss0
     assert l < loss0, (l, loss0)
+
+    # pipeline across the process boundary: 8 stages on the same mesh, the
+    # stage 3 -> 4 activation ppermute spans the two processes' device sets
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from bluefog_tpu.parallel.pipeline import last_stage_value, pipeline_apply
+
+    w_host = np.linspace(0.1, 0.9, n * 16).reshape(n, 4, 4).astype("float32")
+    mb_host = np.linspace(-1, 1, 3 * 2 * 4).reshape(3, 2, 4).astype("float32")
+    w = bf.shard_distributed(jnp.asarray(w_host))
+    mb = jax.device_put(jnp.asarray(mb_host),
+                        NamedSharding(bf.mesh(), P()))
+
+    def pp_f(wl, mbs):
+        out = pipeline_apply(lambda p, x: jnp.tanh(x @ p[0]), wl, mbs,
+                             axis="rank")
+        return last_stage_value(out, axis="rank")
+
+    pp_fn = jax.jit(jax.shard_map(
+        pp_f, mesh=bf.mesh(), in_specs=(P("rank"), P(None)),
+        out_specs=P(None)))
+    out = bf.synchronize(pp_fn(w, mb))
+    expected = mb_host
+    for s in range(n):
+        expected = np.tanh(expected @ w_host[s])
+    got = np.asarray(out.addressable_shards[0].data)
+    assert np.allclose(got, expected, atol=1e-5), np.abs(got - expected).max()
     print(f"proc {jax.process_index()}: MULTIHOST-OK", flush=True)
 """ % REPO)
 

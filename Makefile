@@ -50,6 +50,7 @@ test_examples:
 	$(PY) examples/long_context.py --virtual-cpu --steps 10 \
 		--sp-layout zigzag --rope
 	$(PY) examples/moe.py --virtual-cpu --steps 20
+	$(PY) examples/moe.py --virtual-cpu --steps 30 --top2
 	$(PY) examples/pipeline_lm.py --virtual-cpu --steps 30
 	$(PY) examples/pipeline_lm.py --virtual-cpu --steps 30 --interleaved 2 \
 		--micro 4

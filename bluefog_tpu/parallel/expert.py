@@ -18,7 +18,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-__all__ = ["moe_dispatch", "moe_combine", "moe_apply"]
+__all__ = ["moe_dispatch", "moe_combine", "moe_apply", "moe_apply_topk",
+           "load_balancing_loss"]
 
 Axis = str
 
@@ -101,3 +102,48 @@ def moe_apply(
         raise ValueError("expert_fn must preserve [tokens, D] shape")
     return moe_combine(expert_out.reshape(n_src, cap, D), expert_idx, pos,
                        keep, capacity=capacity, axis=axis)
+
+
+def moe_apply_topk(
+    x: jax.Array,
+    topk_idx: jax.Array,         # [T, k] int: k chosen experts per token
+    topk_gate: jax.Array,        # [T, k] float: the router's gate weights
+    expert_fn: Callable[[Any, jax.Array], jax.Array],
+    expert_params: Any,
+    *,
+    capacity: int,
+    axis: Axis = "expert",
+) -> jax.Array:
+    """Top-k routed MoE layer (k=2 is the classic mixture): each choice
+    dispatches independently (k all_to_all round trips) and the outputs
+    combine under the router's gates.  Dropped slots contribute zero, so
+    a token over capacity in one choice still receives its other experts'
+    gated outputs — the standard static-capacity top-k semantics.
+    """
+    if topk_idx.ndim != 2 or topk_idx.shape != topk_gate.shape:
+        raise ValueError(
+            f"topk_idx/topk_gate must both be [tokens, k], got "
+            f"{topk_idx.shape} / {topk_gate.shape}")
+    y = jnp.zeros_like(x)
+    for j in range(topk_idx.shape[1]):
+        out = moe_apply(x, topk_idx[:, j], expert_fn, expert_params,
+                        capacity=capacity, axis=axis)
+        y = y + out * topk_gate[:, j:j + 1].astype(x.dtype)
+    return y
+
+
+def load_balancing_loss(router_probs: jax.Array,
+                        expert_idx: jax.Array) -> jax.Array:
+    """Switch-Transformer auxiliary load-balancing loss for this device's
+    tokens: ``E * sum_e fraction_routed_e * mean_router_prob_e``.  Minimized
+    (value 1.0) by uniform routing; add ``alpha *`` this to the task loss.
+    ``router_probs`` is the full softmax ``[T, E]``; ``expert_idx`` the
+    (top-1) assignment actually dispatched.  For a global (all-device)
+    balance term, ``lax.pmean`` the returned scalar over the data axes —
+    outside any region differentiated with ``check_vma=False``.
+    """
+    num_experts = router_probs.shape[-1]
+    f = jnp.mean(jax.nn.one_hot(expert_idx, num_experts,
+                                dtype=router_probs.dtype), axis=0)
+    p = jnp.mean(router_probs, axis=0)
+    return num_experts * jnp.sum(f * p)

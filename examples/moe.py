@@ -28,6 +28,10 @@ def main():
     parser.add_argument("--tokens", type=int, default=64)
     parser.add_argument("--dim", type=int, default=8)
     parser.add_argument("--steps", type=int, default=80)
+    parser.add_argument("--top2", action="store_true",
+                        help="top-2 routing with the switch-transformer "
+                             "load-balancing auxiliary loss")
+    parser.add_argument("--balance-alpha", type=float, default=0.01)
     parser.add_argument("--lr", type=float, default=0.05)
     parser.add_argument("--seed", type=int, default=0)
     args = parser.parse_args()
@@ -46,7 +50,8 @@ def main():
     import optax
     from jax.sharding import Mesh, PartitionSpec as P
     import bluefog_tpu as bf
-    from bluefog_tpu.parallel.expert import moe_apply
+    from bluefog_tpu.parallel.expert import (
+        load_balancing_loss, moe_apply, moe_apply_topk)
 
     bf.init(platform="cpu" if args.virtual_cpu else None)
     E, D, T = args.num_experts, args.dim, args.tokens
@@ -78,12 +83,21 @@ def main():
     def grad_step(params, x, y):
         def loss_fn(p):
             logits = x @ p["router"]                      # [T, E] replicated
-            idx = jnp.argmax(logits, axis=-1)
-            gate = jax.nn.softmax(logits)[jnp.arange(T), idx]
+            probs = jax.nn.softmax(logits)
 
             def expert_fn(w, tokens):                     # w: [1, D, D] local
                 return tokens @ w[0]
 
+            if args.top2:
+                gate2, idx2 = jax.lax.top_k(probs, 2)     # [T, 2] each
+                gate2 = gate2 / jnp.sum(gate2, -1, keepdims=True)
+                pred = moe_apply_topk(x, idx2, gate2, expert_fn, p["expert"],
+                                      capacity=capacity, axis="expert")
+                aux = load_balancing_loss(probs, idx2[:, 0])
+                return (jnp.mean((pred - y) ** 2)
+                        + args.balance_alpha * aux)
+            idx = jnp.argmax(logits, axis=-1)
+            gate = probs[jnp.arange(T), idx]
             out = moe_apply(x, idx, expert_fn, p["expert"],
                             capacity=capacity, axis="expert")
             pred = out * gate[:, None]
@@ -114,8 +128,8 @@ def main():
             print(f"step {it}: loss {losses[-1]:.4f}")
 
     assert losses[-1] < losses[0] * 0.5, "MoE did not train"
-    print(f"[moe] {E} experts on {E} devices: loss "
-          f"{losses[0]:.3f} -> {losses[-1]:.3f}")
+    print(f"[moe{'/top2' if args.top2 else ''}] {E} experts on {E} "
+          f"devices: loss {losses[0]:.3f} -> {losses[-1]:.3f}")
 
 
 if __name__ == "__main__":

@@ -5,7 +5,8 @@ import numpy as np
 import pytest
 from jax.sharding import Mesh, PartitionSpec as P
 
-from bluefog_tpu.parallel.expert import moe_apply
+from bluefog_tpu.parallel.expert import (
+    load_balancing_loss, moe_apply, moe_apply_topk)
 
 E = 4       # experts == devices on the axis
 T, D = 8, 3
@@ -72,3 +73,55 @@ def test_expert_fn_receives_flat_matrix(cpu_devices):
         out_specs=P("expert")))
     out = np.asarray(fn(x, idx))
     np.testing.assert_allclose(out, np.asarray(x) * 2.0, rtol=1e-6)
+
+
+def test_topk_combines_gated_experts(cpu_devices):
+    """Top-2 routing: each token's output is the gate-weighted sum of BOTH
+    its experts' transforms (expert e scales by e+1 -> closed form)."""
+    mesh = Mesh(np.array(cpu_devices[:E]), ("expert",))
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.normal(size=(E, T, D)), jnp.float32)
+    i1 = rng.integers(0, E, size=(E, T))
+    i2 = (i1 + 1 + rng.integers(0, E - 1, size=(E, T))) % E   # distinct
+    idx = jnp.asarray(np.stack([i1, i2], -1), jnp.int32)       # [E, T, 2]
+    gate = jnp.asarray(rng.uniform(0.2, 0.8, size=(E, T, 2)), jnp.float32)
+
+    def f(xb, ib, gb):
+        eid = jax.lax.axis_index("expert").astype(jnp.float32)
+        return moe_apply_topk(xb[0], ib[0], gb[0],
+                              lambda p, t: t * (p + 1.0), eid,
+                              capacity=T, axis="expert")[None]
+
+    fn = jax.jit(jax.shard_map(
+        f, mesh=mesh, in_specs=(P("expert"),) * 3, out_specs=P("expert")))
+    out = np.asarray(fn(x, idx, gate))
+    g, i = np.asarray(gate), np.asarray(idx)
+    expected = np.asarray(x) * (g[..., 0] * (i[..., 0] + 1.0)
+                                + g[..., 1] * (i[..., 1] + 1.0))[..., None]
+    np.testing.assert_allclose(out, expected, rtol=1e-5, atol=1e-6)
+
+
+def test_topk_shape_mismatch_raises():
+    with pytest.raises(ValueError, match="tokens, k"):
+        moe_apply_topk(jnp.zeros((4, 2)), jnp.zeros((4, 2), jnp.int32),
+                       jnp.zeros((4, 3)), lambda p, t: t, None,
+                       capacity=2)
+
+
+def test_load_balancing_loss_prefers_uniform_routing():
+    """Uniform routing scores exactly 1.0; a collapsed router scores E."""
+    E_ = 4
+    T_ = 64
+    uniform_probs = jnp.full((T_, E_), 1.0 / E_)
+    uniform_idx = jnp.asarray(np.arange(T_) % E_, jnp.int32)
+    np.testing.assert_allclose(
+        float(load_balancing_loss(uniform_probs, uniform_idx)), 1.0,
+        rtol=1e-6)
+    collapsed_probs = jnp.zeros((T_, E_)).at[:, 0].set(1.0)
+    collapsed_idx = jnp.zeros((T_,), jnp.int32)
+    np.testing.assert_allclose(
+        float(load_balancing_loss(collapsed_probs, collapsed_idx)), E_,
+        rtol=1e-6)
+    # and it is differentiable w.r.t. the router probs
+    g = jax.grad(lambda p: load_balancing_loss(p, uniform_idx))(uniform_probs)
+    assert np.isfinite(np.asarray(g)).all()

@@ -51,6 +51,7 @@ test_examples:
 		--sp-layout zigzag --rope
 	$(PY) examples/moe.py --virtual-cpu --steps 20
 	$(PY) examples/moe.py --virtual-cpu --steps 30 --top2
+	$(PY) examples/moe_lm.py --virtual-cpu --steps 40
 	$(PY) examples/pipeline_lm.py --virtual-cpu --steps 30
 	$(PY) examples/pipeline_lm.py --virtual-cpu --steps 30 --interleaved 2 \
 		--micro 4

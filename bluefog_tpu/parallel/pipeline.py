@@ -54,10 +54,9 @@ def _vary(z: jax.Array, axis: Axis, *likes) -> jax.Array:
             except (AttributeError, TypeError):
                 pass
     for ax in sorted(need):
-        try:
-            z = lax.pcast(z, ax, to='varying')
-        except ValueError:
-            pass                     # already varying over ax
+        # z is always a fresh unvarying zeros array and `need` is a set,
+        # so each axis is cast exactly once — any pcast error is real
+        z = lax.pcast(z, ax, to='varying')
     return z
 
 
@@ -232,7 +231,7 @@ def pipeline_1f1b_grad(
         stash, fwd_inbox = fwd_tick(t, stage_params, stash, fwd_inbox)
         return (stash, fwd_inbox, bwd_inbox, dparams, loss_acc), None
 
-    vary = lambda x: _vary(x, axis, microbatches, stage_params)
+    vary = lambda x: _vary(x, axis, microbatches, stage_params, targets)
     carry0 = (
         vary(jnp.zeros((buf,) + act_shape, act_dtype)),          # stash
         vary(jnp.zeros(act_shape, act_dtype)),                   # fwd inbox

@@ -1,11 +1,13 @@
-"""Sequence-parallel microbenchmark: contiguous vs zigzag causal rings.
+"""Sequence-parallel microbenchmark: ring layouts vs ulysses all-to-all.
 
 On the virtual CPU mesh all 8 emulated devices share one core, so wall
 clock tracks TOTAL work — which exposes the zigzag saving directly: the
 contiguous causal ring computes (and then masks) every K/V block on every
 device, while zigzag computes exactly the visible half.  On real TPU the
 same factor shows up as wall clock through load balance (the contiguous
-ring's critical path is the last device computing all n blocks).
+ring's critical path is the last device computing all n blocks).  The
+ulysses row re-shards with 2 all_to_alls and runs dense local attention —
+fewer, bigger collectives; compare when heads >= devices.
 
 Run: python tools/sp_bench.py --virtual-cpu [--seq 4096] [--iters 5]
 """
@@ -55,17 +57,30 @@ def main():
             f, mesh=bf.mesh(), in_specs=(P(None, "rank"),) * 3,
             out_specs=P(None, "rank")))
 
-    print(f"causal ring attention, seq {T} over {n} devices "
+    def build_ulysses():
+        from bluefog_tpu.ops import ulysses_attention
+
+        def f(qb, kb, vb):
+            return ulysses_attention(qb, kb, vb, axis="rank", causal=True)
+        return jax.jit(jax.shard_map(
+            f, mesh=bf.mesh(), in_specs=(P(None, "rank"),) * 3,
+            out_specs=P(None, "rank")))
+
+    print(f"causal attention, seq {T} over {n} devices "
           f"({T // n}/device), {H} heads x {D}:")
-    for layout in ("contiguous", "zigzag"):
-        fn = build(layout)
+    modes = [("contiguous", build("contiguous")), ("zigzag", build("zigzag"))]
+    if H % n == 0:
+        modes.append(("ulysses", build_ulysses()))
+    else:
+        print(f"  (ulysses skipped: heads {H} not divisible by {n} devices)")
+    for name, fn in modes:
         out = bf.hard_sync(fn(q, k, v))          # compile + warm
         t0 = time.perf_counter()
         for _ in range(args.iters):
             out = fn(q, k, v)
         bf.hard_sync(out)
         ms = (time.perf_counter() - t0) / args.iters * 1e3
-        print(f"  {layout:>11}: {ms:8.1f} ms/step")
+        print(f"  {name:>11}: {ms:8.1f} ms/step")
 
 
 if __name__ == "__main__":

@@ -34,6 +34,31 @@ def test_roundtrip(tmp_path):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+def test_roundtrip_mixed_sharding_2d(tmp_path):
+    """A composed-model state (2-D mesh, per-axis-sharded + replicated
+    leaves, the moe_lm/zero shape) restores with values AND shardings
+    intact when the template carries the shardings."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 4),
+                ("rank", "expert"))
+    rng = np.random.default_rng(1)
+    put = lambda a, spec: jax.device_put(
+        jnp.asarray(a, jnp.float32), NamedSharding(mesh, spec))
+    state = {
+        "router": put(rng.normal(size=(2, 4, 6)), P("rank")),
+        "expert": put(rng.normal(size=(2, 4, 3, 3)), P("rank", "expert")),
+        "replicated": put(rng.normal(size=(5,)), P()),
+    }
+    path = ckpt.save(str(tmp_path), state, step=1)
+    out = ckpt.restore(path, template=state)
+    for key in state:
+        np.testing.assert_array_equal(np.asarray(out[key]),
+                                      np.asarray(state[key]), err_msg=key)
+        assert out[key].sharding == state[key].sharding, (
+            key, out[key].sharding)
+
+
 def test_resume_training_is_bitwise_identical(tmp_path):
     """Train 3 steps, checkpoint, train 3 more; vs restore + 3 -> identical."""
     target = jnp.ones((N, 1, 5)) * 2.0

@@ -38,6 +38,9 @@ def main():
     parser.add_argument("--batch-size", type=int, default=32)
     parser.add_argument("--num-warmup", type=int, default=1)
     parser.add_argument("--num-iters", type=int, default=10)
+    parser.add_argument("--steps-per-call", type=int, default=1,
+                        help="scan K optimizer steps into one compiled "
+                             "program (amortizes dispatch; see bench.py)")
     parser.add_argument("--profile", default=None,
                         help="write a timeline to this path prefix")
     args = parser.parse_args()
@@ -169,11 +172,16 @@ def main():
 
     dist_params = bfopt.replicate(state0)
     dist_state = bfopt.init_distributed(strategy, dist_params)
-    step = bfopt.make_train_step(grad_fn, strategy)
+    spc = args.steps_per_call
+    step = bfopt.make_train_step(grad_fn, strategy, steps_per_call=spc)
 
     if args.profile:
         timeline.start_timeline(args.profile)
 
+    if spc > 1:
+        # steps axis after the rank axis (make_train_step's scan contract)
+        xb = jnp.broadcast_to(xb[:, None], (xb.shape[0], spc) + xb.shape[1:])
+        yb = jnp.broadcast_to(yb[:, None], (yb.shape[0], spc) + yb.shape[1:])
     batch = (xb, yb)
     for _ in range(args.num_warmup):
         dist_params, dist_state, loss = step(dist_params, dist_state, batch)
@@ -189,7 +197,7 @@ def main():
     if args.profile:
         timeline.stop_timeline()
 
-    total = args.num_iters * B * n
+    total = args.num_iters * spc * B * n
     print(f"Model: {args.model}, optimizer: {name}"
           f"{'+dynamic' if args.dynamic_topology else ''}"
           f"{' (ATC)' if args.atc else ''}")

@@ -5,10 +5,15 @@
 PY ?= python
 
 .PHONY: test test_basic test_ops test_win_ops test_optimizer test_hier \
-	test_native test_examples native clean
+	test_native test_examples verify native clean
 
 test:
 	$(PY) -m pytest tests/ -q
+
+# everything verifiable without hardware: suite + example smokes + the
+# multi-chip dryrun the driver runs
+verify: test test_examples
+	$(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 
 test_basic:
 	$(PY) -m pytest tests/test_topology.py tests/test_schedule.py -q

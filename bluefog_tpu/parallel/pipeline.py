@@ -32,10 +32,12 @@ from typing import Any, Callable, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 __all__ = ["pipeline_apply", "last_stage_value", "pipeline_1f1b_grad",
-           "pipeline_interleaved_apply"]
+           "pipeline_interleaved_apply", "pipeline_apply_stages",
+           "pack_stage_params"]
 
 Axis = str
 
@@ -341,6 +343,149 @@ def pipeline_interleaved_apply(
                     microbatches, chunk_params))
     (_, outputs), _ = lax.scan(tick, carry0, jnp.arange(ticks))
     return outputs
+
+
+def pack_stage_params(trees):
+    """Pack per-stage param pytrees (different structures allowed) into one
+    uniform ``[S, P_max]`` flat buffer + per-stage unpack functions.
+
+    SPMD needs every device to hold the same operand type; heterogeneous
+    stages don't have one.  The escape is a padded flat buffer per stage
+    (single dtype, zero-padded to the largest stage) with static unpack
+    closures restoring stage ``s``'s tree from its slice layout — the same
+    trick the fusion layer plays for collectives.  Returns
+    ``(stacked [S, P_max], unpack_fns)``; shard the stack ``P("stage")``
+    and pass device-local ``stacked[0]`` as ``stage_params``.
+    """
+    flats, unpacks = [], []
+    for tree in trees:
+        leaves, treedef = jax.tree.flatten(tree)
+        if not leaves:
+            raise ValueError("a stage has no parameters")
+        dtype = leaves[0].dtype
+        if any(l.dtype != dtype for l in leaves):
+            raise ValueError(
+                "pack_stage_params needs a single param dtype per stage "
+                f"(got {sorted({str(l.dtype) for l in leaves})})")
+        shapes = [l.shape for l in leaves]
+        sizes = [int(np.prod(s)) if s else 1 for s in shapes]
+        flats.append(jnp.concatenate([l.reshape(-1) for l in leaves]))
+
+        def unpack(buf, treedef=treedef, shapes=shapes, sizes=sizes):
+            out, off = [], 0
+            for sh, sz in zip(shapes, sizes):
+                out.append(buf[off:off + sz].reshape(sh))
+                off += sz
+            return jax.tree.unflatten(treedef, out)
+
+        unpacks.append(unpack)
+    pmax = max(f.size for f in flats)
+    stacked = jnp.stack([jnp.pad(f, (0, pmax - f.size)) for f in flats])
+    return stacked, unpacks
+
+
+def pipeline_apply_stages(
+    stage_fns,
+    unpack_fns,
+    stage_params: jax.Array,
+    microbatches: jax.Array,
+    *,
+    boundary_shapes,
+    boundary_dtype=jnp.float32,
+    axis: Axis = "stage",
+    remat: bool = False,
+) -> jax.Array:
+    """Heterogeneous pipeline: per-stage FUNCTIONS, PARAMS, and ACTIVATION
+    SHAPES — embedding and head live inside the pipeline instead of being
+    replicated around it (:mod:`examples/pipeline_lm.py`'s workaround for
+    the uniform contract of :func:`pipeline_apply`).
+
+    Every device runs the same program; stage identity is a
+    ``lax.switch`` over ``stage_fns`` selected by the device's stage
+    index, and stage boundaries ride ONE zero-padded flat buffer sized to
+    the largest boundary (``boundary_dtype``; the stage-0 INPUT comes
+    straight from ``microbatches`` and may be any shape/dtype — e.g.
+    int32 tokens).  Autodiff through the schedule is the backward
+    pipeline, as for :func:`pipeline_apply`.
+
+    Args:
+      stage_fns: length-``S`` list; ``stage_fns[s](params_s, x) -> y`` with
+        ``x`` of shape ``boundary_shapes[s-1]`` (``microbatches[m]`` for
+        ``s=0``) and ``y`` of shape ``boundary_shapes[s]``.
+      unpack_fns: from :func:`pack_stage_params`.
+      stage_params: this device's ``[P_max]`` packed param buffer.
+      microbatches: ``[M, ...]`` stage-0 inputs.
+      boundary_shapes: length-``S``; ``boundary_shapes[s]`` is the shape
+        LEAVING stage ``s`` (the last entry is the pipeline output shape).
+      boundary_dtype: dtype of every boundary activation.
+
+    Returns:
+      ``[M, *boundary_shapes[-1]]`` — real on the last stage, zeros
+      elsewhere (compose with :func:`last_stage_value`).
+    """
+    S = len(stage_fns)
+    if len(unpack_fns) != S or len(boundary_shapes) != S:
+        raise ValueError(
+            f"stage_fns/unpack_fns/boundary_shapes must all have length S "
+            f"({S} / {len(unpack_fns)} / {len(boundary_shapes)})")
+    n_stage = lax.axis_size(axis)
+    if n_stage != S:
+        raise ValueError(f"{S} stages need a {S}-device '{axis}' axis "
+                         f"(got {n_stage})")
+    sid = lax.axis_index(axis)
+    M = microbatches.shape[0]
+    ticks = M + S - 1
+    sizes = [int(np.prod(s)) for s in boundary_shapes]
+    A = max(sizes)
+    out_size = sizes[-1]
+
+    def make_branch(s):
+        fn = stage_fns[s]
+        if remat:
+            fn = jax.checkpoint(
+                fn, policy=jax.checkpoint_policies.nothing_saveable)
+
+        def branch(flat_params, inbox, mb):
+            x = mb if s == 0 else \
+                inbox[:sizes[s - 1]].reshape(boundary_shapes[s - 1])
+            y = fn(unpack_fns[s](flat_params), x)
+            if y.shape != tuple(boundary_shapes[s]):
+                raise ValueError(
+                    f"stage {s} returned {y.shape}, declared "
+                    f"{tuple(boundary_shapes[s])}")
+            y = y.reshape(-1).astype(boundary_dtype)
+            return jnp.pad(y, (0, A - y.size))
+
+        return branch
+
+    branches = [make_branch(s) for s in range(S)]
+    fwd = tuple((i, i + 1) for i in range(S - 1))
+
+    def tick(carry, t):
+        inbox, outputs = carry
+        my_mb = t - sid
+        valid = (my_mb >= 0) & (my_mb < M)
+        mb_idx = jnp.clip(my_mb, 0, M - 1)
+        # stage 0 is the only consumer of the raw microbatch; other
+        # branches ignore it (traced uniformly for the switch signature)
+        mb = lax.dynamic_index_in_dim(
+            microbatches, jnp.where(sid == 0, jnp.clip(t, 0, M - 1), mb_idx),
+            keepdims=False)
+        y = lax.switch(sid, branches, stage_params, inbox, mb)
+        y = jnp.where(valid, y, jnp.zeros_like(y))
+        record = valid & (sid == S - 1)
+        cur = lax.dynamic_index_in_dim(outputs, mb_idx, keepdims=False)
+        outputs = lax.dynamic_update_index_in_dim(
+            outputs, jnp.where(record, y, cur), mb_idx, axis=0)
+        inbox = lax.ppermute(y, axis, perm=fwd) if fwd else y
+        return (inbox, outputs), None
+
+    carry0 = (_vary(jnp.zeros((A,), boundary_dtype), axis, microbatches,
+                    stage_params),
+              _vary(jnp.zeros((M, A), boundary_dtype), axis, microbatches,
+                    stage_params))
+    (_, outputs), _ = lax.scan(tick, carry0, jnp.arange(ticks))
+    return outputs[:, :out_size].reshape((M,) + tuple(boundary_shapes[-1]))
 
 
 def last_stage_value(x: jax.Array, *, axis: Axis = "stage") -> jax.Array:

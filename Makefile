@@ -60,6 +60,7 @@ test_examples:
 	$(PY) examples/pipeline_lm.py --virtual-cpu --steps 30
 	$(PY) examples/pipeline_lm.py --virtual-cpu --steps 30 --interleaved 2 \
 		--micro 4
+	$(PY) examples/pipeline_lm.py --virtual-cpu --steps 30 --hetero
 
 # build the native (C++) components explicitly (otherwise built lazily)
 native:

@@ -44,6 +44,11 @@ def main():
                         help="virtual chunks per device (V>1: Megatron-style "
                              "interleaved ring schedule, ~V-fold smaller "
                              "bubble; requires --micro <= --stages)")
+    parser.add_argument("--hetero", action="store_true",
+                        help="heterogeneous stages: embed and head live "
+                             "INSIDE the pipeline (stage 0 / stage S-1) "
+                             "via pipeline_apply_stages, instead of being "
+                             "replicated on every device")
     parser.add_argument("--seed", type=int, default=0)
     args = parser.parse_args()
     if args.lag < 1:
@@ -55,6 +60,10 @@ def main():
     if args.interleaved > 1 and args.micro > args.stages:
         parser.error("interleaved schedule needs --micro <= --stages "
                      "(stream bigger batches in groups of S)")
+    if args.hetero and args.interleaved > 1:
+        parser.error("--hetero and --interleaved are separate schedules")
+    if args.hetero and args.stages < 3:
+        parser.error("--hetero needs >= 3 stages (embed + blocks + head)")
 
     if args.virtual_cpu:
         flags = os.environ.get("XLA_FLAGS", "")
@@ -70,7 +79,8 @@ def main():
     import optax
     from jax.sharding import Mesh, PartitionSpec as P
     from bluefog_tpu.parallel.pipeline import (
-        last_stage_value, pipeline_apply, pipeline_interleaved_apply)
+        last_stage_value, pack_stage_params, pipeline_apply,
+        pipeline_apply_stages, pipeline_interleaved_apply)
 
     S, M, T, D, H = args.stages, args.micro, args.seq_len, args.d_model, args.heads
     B, vocab = 2, 32
@@ -79,6 +89,86 @@ def main():
     mesh = Mesh(np.array(devices[:S]), ("stage",))
 
     rng = np.random.default_rng(args.seed)
+
+    def ln(z):
+        mu = z.mean(-1, keepdims=True)
+        return (z - mu) / jnp.sqrt(z.var(-1, keepdims=True) + 1e-6)
+
+    if args.hetero:
+        # ---- heterogeneous stages: embed | blocks | head in the pipe ----
+        def w(*shape, scale=0.1):
+            return jnp.asarray(rng.normal(size=shape) * scale, jnp.float32)
+
+        def block_fn(p, x):
+            hsz = D // H
+            h = ln(x)
+            qkv = h @ p["wqkv"]
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            q = q.reshape(B, T, H, hsz)
+            k = k.reshape(B, T, H, hsz)
+            v = v.reshape(B, T, H, hsz)
+            sc = jnp.einsum("bihd,bjhd->bhij", q, k) / jnp.sqrt(float(hsz))
+            mask = jnp.tril(jnp.ones((T, T), bool))
+            sc = jnp.where(mask[None, None], sc, -jnp.inf)
+            a = jax.nn.softmax(sc, axis=-1)
+            att = jnp.einsum("bhij,bjhd->bihd", a, v).reshape(B, T, D)
+            x = x + att @ p["wo"]
+            h = ln(x)
+            return x + jax.nn.gelu(h @ p["w1"]) @ p["w2"]
+
+        stage_trees = (
+            [{"embed": w(vocab, D), "pos": w(T, D)}]
+            + [{"wqkv": w(D, 3 * D), "wo": w(D, D),
+                "w1": w(D, 4 * D), "w2": w(4 * D, D)}
+               for _ in range(S - 2)]
+            + [{"head": w(D, vocab)}])
+        fns = ([lambda p, t: p["embed"][t] + p["pos"][None]]
+               + [block_fn] * (S - 2)
+               + [lambda p, x: ln(x) @ p["head"]])
+        shapes = [(B, T, D)] * (S - 1) + [(B, T, vocab)]
+        stacked, unpacks = pack_stage_params(stage_trees)
+
+        opt = optax.adam(args.lr)
+        opt_state = opt.init(stacked)
+        o_spec = jax.tree.map(lambda x: P("stage") if x.ndim else P(),
+                              opt_state)
+
+        def train_step(flat, opt_state, tokens, targets):
+            def loss_fn(buf):
+                out = pipeline_apply_stages(
+                    fns, unpacks, buf[0], tokens[0],
+                    boundary_shapes=shapes, remat=args.remat)
+                out = last_stage_value(out, axis="stage")
+                mask = (targets[0] >= 0).astype(jnp.float32)
+                ce = optax.softmax_cross_entropy_with_integer_labels(
+                    out, jnp.maximum(targets[0], 0))
+                return jnp.sum(ce * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+            loss, g = jax.value_and_grad(loss_fn)(flat)
+            updates, opt_state = opt.update(g, opt_state, flat)
+            return optax.apply_updates(flat, updates), opt_state, loss[None]
+
+        fn = jax.jit(jax.shard_map(
+            train_step, mesh=mesh,
+            in_specs=(P("stage"), o_spec, P(None), P(None)),
+            out_specs=(P("stage"), o_spec, P("stage"))))
+
+        losses = []
+        for it in range(args.steps):
+            seq = rng.integers(0, vocab, size=(M, B, T))
+            tgt = np.full((M, B, T), -1, np.int64)
+            tgt[..., args.lag:] = seq[..., :-args.lag]
+            stacked, opt_state, loss = fn(
+                stacked, opt_state, jnp.asarray(seq, jnp.int32)[None],
+                jnp.asarray(tgt, jnp.int32)[None])
+            losses.append(float(jax.block_until_ready(loss)[0]))
+            if it % 20 == 0 or it == args.steps - 1:
+                print(f"step {it}: loss {losses[-1]:.4f} "
+                      f"(embed|{S - 2} blocks|head in-pipe)")
+        assert losses[-1] < losses[0], "no training progress through stages"
+        print(f"[pipeline/hetero] loss {losses[0]:.3f} -> {losses[-1]:.3f}: "
+              f"embed + {S - 2} blocks + head as {S} heterogeneous stages")
+        return
 
     def init_block():
         def w(*shape, scale=0.1):
@@ -102,10 +192,6 @@ def main():
         "head": jnp.asarray(rng.normal(size=(D, vocab)) * 0.1, jnp.float32),
         "stage": stage_params,
     }
-
-    def ln(z):
-        mu = z.mean(-1, keepdims=True)
-        return (z - mu) / jnp.sqrt(z.var(-1, keepdims=True) + 1e-6)
 
     def block_fn(p, x):
         # one pre-LN decoder block; x: [B, T, D]; p: one block's weights

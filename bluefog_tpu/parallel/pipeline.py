@@ -358,15 +358,22 @@ def pack_stage_params(trees):
     and pass device-local ``stacked[0]`` as ``stage_params``.
     """
     flats, unpacks = [], []
+    buf_dtype = None
     for tree in trees:
         leaves, treedef = jax.tree.flatten(tree)
         if not leaves:
             raise ValueError("a stage has no parameters")
         dtype = leaves[0].dtype
-        if any(l.dtype != dtype for l in leaves):
+        seen = {str(l.dtype) for l in leaves}
+        if buf_dtype is not None:
+            seen.add(str(buf_dtype))
+        if len(seen) > 1:
+            # cross-stage too: jnp.stack would silently promote, handing a
+            # stage params in a dtype it never declared
             raise ValueError(
-                "pack_stage_params needs a single param dtype per stage "
-                f"(got {sorted({str(l.dtype) for l in leaves})})")
+                "pack_stage_params needs a single param dtype across all "
+                f"stages (got {sorted(seen)})")
+        buf_dtype = dtype
         shapes = [l.shape for l in leaves]
         sizes = [int(np.prod(s)) if s else 1 for s in shapes]
         flats.append(jnp.concatenate([l.reshape(-1) for l in leaves]))
@@ -468,9 +475,7 @@ def pipeline_apply_stages(
         mb_idx = jnp.clip(my_mb, 0, M - 1)
         # stage 0 is the only consumer of the raw microbatch; other
         # branches ignore it (traced uniformly for the switch signature)
-        mb = lax.dynamic_index_in_dim(
-            microbatches, jnp.where(sid == 0, jnp.clip(t, 0, M - 1), mb_idx),
-            keepdims=False)
+        mb = lax.dynamic_index_in_dim(microbatches, mb_idx, keepdims=False)
         y = lax.switch(sid, branches, stage_params, inbox, mb)
         y = jnp.where(valid, y, jnp.zeros_like(y))
         record = valid & (sid == S - 1)

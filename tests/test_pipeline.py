@@ -466,3 +466,8 @@ class TestHeterogeneousStages:
             pack_stage_params([
                 {"a": jnp.zeros((2,), jnp.float32),
                  "b": jnp.zeros((2,), jnp.bfloat16)}])
+        # ... and ACROSS stages (jnp.stack would silently promote)
+        with pytest.raises(ValueError, match="single param dtype"):
+            pack_stage_params([
+                {"a": jnp.zeros((2,), jnp.float32)},
+                {"a": jnp.zeros((2,), jnp.bfloat16)}])

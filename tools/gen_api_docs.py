@@ -55,10 +55,14 @@ def _doc_head(obj, max_paras=1):
 
 
 def _signature(obj):
+    import re
     try:
-        return str(inspect.signature(obj))
+        sig = str(inspect.signature(obj))
     except (ValueError, TypeError):
         return "(...)"
+    # repr'd default objects embed memory addresses — nondeterministic
+    # churn on every regeneration
+    return re.sub(r" at 0x[0-9a-f]+", "", sig)
 
 
 def _members(mod):

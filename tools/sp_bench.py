@@ -23,7 +23,9 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--virtual-cpu", action="store_true")
     ap.add_argument("--seq", type=int, default=4096)
-    ap.add_argument("--heads", type=int, default=4)
+    ap.add_argument("--heads", type=int, default=8)   # divisible by the
+                                                      # 8-device mesh so the
+                                                      # ulysses row runs
     ap.add_argument("--head-dim", type=int, default=64)
     ap.add_argument("--iters", type=int, default=5)
     args = ap.parse_args()

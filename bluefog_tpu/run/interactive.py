@@ -16,6 +16,15 @@ Wire format: 4-byte big-endian length + JSON.  No third-party dependency
 (the reference vendors ipyparallel; here ~stdlib sockets suffice because
 there is no engine scheduling — every cell goes to every rank, by design).
 
+Authentication: executing arbitrary cells over TCP is remote code execution
+by design, so the controller mints a per-session token (the counterpart of
+ipyparallel's engine key, ``interactive_run.py:34-96``) that every worker
+must echo in its hello.  The launcher forwards it to spawned workers via
+``BLUEFOG_SESSION_TOKEN``; remote workers take ``--token`` (printed by the
+controller at startup, like a notebook server).  Comparison is constant
+time; a bad token gets an explicit ``auth-failed`` reply then a closed
+socket, and never counts toward the expected worker set.
+
 Usage (mirrors ``ibfrun start``/``ibfrun stop``):
 
     # on each host (or once per host via your pod launcher):
@@ -31,8 +40,10 @@ from __future__ import annotations
 
 import codeop
 import contextlib
+import hmac
 import io
 import json
+import secrets
 import socket
 import struct
 import sys
@@ -124,8 +135,11 @@ class Controller:
     is ``view.execute`` with a gather of per-rank results."""
 
     def __init__(self, num_workers: int, port: int = 0,
-                 host: str = "0.0.0.0"):
+                 host: str = "0.0.0.0", token: Optional[str] = None):
         self.num_workers = num_workers
+        # empty means unset: an empty token would match a token-less hello,
+        # silently disabling auth on a 0.0.0.0 listener
+        self.token = token or secrets.token_hex(16)
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._srv.bind((host, port))
@@ -150,6 +164,19 @@ class Controller:
                 hello = recv_msg(conn)
                 if hello.get("type") != "hello":
                     raise ValueError("not a hello")
+                # compare bytes: compare_digest raises TypeError on
+                # non-ASCII str, which the catch-all below would turn into
+                # a silent close instead of a loud auth-failed
+                presented = str(hello.get("token", "")).encode(
+                    "utf-8", "surrogatepass")
+                if not hmac.compare_digest(presented, self.token.encode()):
+                    # loud rejection so a mis-tokened worker fails fast
+                    # instead of hanging; the bad peer never joins the set
+                    with contextlib.suppress(OSError):
+                        send_msg(conn, {"type": "auth-failed",
+                                        "error": "bad or missing session "
+                                                 "token"})
+                    raise ValueError("bad session token")
                 pid = int(hello["process_id"])
             except (OSError, ValueError, AttributeError, KeyError, TypeError):
                 conn.close()
@@ -220,7 +247,8 @@ class Controller:
         self._srv.close()
 
 
-def worker_main(controller_addr: str, platform: Optional[str] = None) -> int:
+def worker_main(controller_addr: str, platform: Optional[str] = None,
+                token: Optional[str] = None) -> int:
     """Run one interactive worker: ``bf.init()`` (joining the distributed
     mesh via the usual BLUEFOG_*/pod env), connect to the controller, then
     execute cells until shutdown.  The namespace is pre-seeded like the
@@ -228,6 +256,9 @@ def worker_main(controller_addr: str, platform: Optional[str] = None) -> int:
     import os
 
     import bluefog_tpu as bf
+
+    token = token if token is not None else os.environ.get(
+        "BLUEFOG_SESSION_TOKEN", "")
 
     # honor JAX_PLATFORMS even when a boot-time platform plugin (axon) has
     # already forced jax_platforms — bf.init(platform=...) pins the config
@@ -241,12 +272,25 @@ def worker_main(controller_addr: str, platform: Optional[str] = None) -> int:
     host, port = parse_addr(controller_addr)
     sock = socket.create_connection((host, port), timeout=300.0)
     sock.settimeout(None)
-    send_msg(sock, {"type": "hello", "process_id": jax.process_index()})
+    send_msg(sock, {"type": "hello", "process_id": jax.process_index(),
+                    "token": token})
+    return worker_loop(sock, namespace)
+
+
+def worker_loop(sock: socket.socket, namespace: Dict[str, Any]) -> int:
+    """Post-hello worker state machine: execute cells until shutdown; an
+    auth-failed reply is a loud non-zero exit (mis-tokened launches fail
+    fast instead of hanging)."""
     while True:
         try:
             msg = recv_msg(sock)
         except (ConnectionError, OSError):
             return 0
+        if msg.get("type") == "auth-failed":
+            print(f"controller rejected this worker: {msg.get('error')} "
+                  "(pass the session token printed by the controller via "
+                  "--token or BLUEFOG_SESSION_TOKEN)", file=sys.stderr)
+            return 1
         if msg.get("type") == "shutdown":
             return 0
         if msg.get("type") == "cell":
@@ -317,8 +361,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--connect", required=True,
                    help="controller address host:port")
     p.add_argument("--platform", default=None)
+    p.add_argument("--token", default=None,
+                   help="session token printed by the controller; argv is "
+                        "visible in `ps` on shared hosts — prefer the "
+                        "BLUEFOG_SESSION_TOKEN env var there (default)")
     args = p.parse_args(argv)
-    return worker_main(args.connect, platform=args.platform)
+    return worker_main(args.connect, platform=args.platform,
+                       token=args.token)
 
 
 if __name__ == "__main__":

@@ -65,6 +65,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--controller", default=None,
                    help="controller address host:port "
                         "(with --interactive-worker)")
+    p.add_argument("--session-token", default=None,
+                   help="interactive session token: with "
+                        "--interactive-worker, the token printed by the "
+                        "controller; with --interactive, a fixed token to "
+                        "use instead of a generated one. NOTE: argv is "
+                        "visible in `ps` on shared hosts — prefer the "
+                        "BLUEFOG_SESSION_TOKEN env var there (default)")
     p.add_argument("--listen-port", type=int, default=0,
                    help="port the interactive controller listens on "
                         "(default: ephemeral, printed at start)")
@@ -101,18 +108,25 @@ def _interactive_cluster(args, env) -> int:
     from .interactive import Controller, repl
 
     n = args.num_local_processes or args.num_processes
-    # local spawn never exposes the unauthenticated cell socket beyond
-    # loopback; remote-worker mode must listen on all interfaces
+    # local spawn keeps the cell socket on loopback; remote-worker mode must
+    # listen on all interfaces — either way cells only execute for peers
+    # presenting the session token
     host = "127.0.0.1" if args.num_local_processes else "0.0.0.0"
-    ctrl = Controller(n, port=args.listen_port, host=host)
+    token = args.session_token or os.environ.get("BLUEFOG_SESSION_TOKEN")
+    ctrl = Controller(n, port=args.listen_port, host=host, token=token)
     print(f"interactive controller listening on port {ctrl.port} "
           f"({n} worker(s))", flush=True)
     procs = []
     if args.num_local_processes:
+        env = dict(env, BLUEFOG_SESSION_TOKEN=ctrl.token)
         procs = _spawn_local_workers(
             n, args.coordinator or "127.0.0.1:48293", env,
             [sys.executable, "-m", "bluefog_tpu.run.interactive",
              "--connect", f"127.0.0.1:{ctrl.port}"])
+    else:
+        # remote workers need the token out of band (notebook-server style)
+        print("session token (pass to each worker via --session-token or "
+              f"BLUEFOG_SESSION_TOKEN): {ctrl.token}", flush=True)
     try:
         ranks = ctrl.wait_for_workers()
         print(f"workers ready: ranks {ranks}", flush=True)
@@ -169,6 +183,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         # forward any --coordinator bootstrap into its env
         if args.coordinator:
             _apply_coordinator_env(args, env)
+        if args.session_token:
+            env["BLUEFOG_SESSION_TOKEN"] = args.session_token
         return subprocess.call(
             [sys.executable, "-m", "bluefog_tpu.run.interactive",
              "--connect", args.controller], env=env)

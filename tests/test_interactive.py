@@ -86,7 +86,8 @@ def test_duplicate_process_id_rejected():
 
     def fake_worker():
         s = socket.create_connection(("127.0.0.1", ctrl.port))
-        it.send_msg(s, {"type": "hello", "process_id": 0})
+        it.send_msg(s, {"type": "hello", "process_id": 0,
+                        "token": ctrl.token})
         socks.append(s)
 
     t1 = threading.Thread(target=fake_worker)
@@ -104,7 +105,8 @@ def test_slow_cell_drops_worker_not_session():
 
     def fake_worker():
         s = socket.create_connection(("127.0.0.1", ctrl.port))
-        it.send_msg(s, {"type": "hello", "process_id": 0})
+        it.send_msg(s, {"type": "hello", "process_id": 0,
+                        "token": ctrl.token})
         it.recv_msg(s)          # the cell — never reply
         try:
             it.recv_msg(s)      # hold the socket open until shutdown
@@ -118,6 +120,91 @@ def test_slow_cell_drops_worker_not_session():
     assert "dropped" in replies[0]["error"]
     assert ctrl._workers == {}     # desynced stream is gone, not reused
     ctrl.shutdown()
+
+
+def test_unauthenticated_worker_rejected():
+    """A hello with a wrong (or missing) token never joins the worker set:
+    it gets an explicit auth-failed reply and a closed socket, while a
+    correctly-tokened worker that follows is accepted (the ipyparallel
+    engine-key counterpart)."""
+    ctrl = it.Controller(1, port=0, host="127.0.0.1")
+    assert ctrl.token and len(ctrl.token) >= 32   # 16 random bytes, hex
+
+    results = {}
+
+    def bad_worker(name, hello):
+        s = socket.create_connection(("127.0.0.1", ctrl.port))
+        it.send_msg(s, hello)
+        try:
+            results[name] = it.recv_msg(s)
+            it.recv_msg(s)              # then the close
+            results[name + "_closed"] = False
+        except (ConnectionError, OSError):
+            results[name + "_closed"] = True
+        finally:
+            s.close()
+
+    def good_worker():
+        s = socket.create_connection(("127.0.0.1", ctrl.port))
+        it.send_msg(s, {"type": "hello", "process_id": 0,
+                        "token": ctrl.token})
+        results["good"] = True
+        # hold the socket open so the controller keeps it in the set
+        try:
+            it.recv_msg(s)
+        except (ConnectionError, OSError):
+            pass
+        s.close()
+
+    # the controller only accept()s inside wait_for_workers, so it must be
+    # live while the bad peers dial in — run it in the background and keep
+    # it running (rejected peers never count toward num_workers)
+    accepted = []
+    waiter = threading.Thread(
+        target=lambda: accepted.extend(ctrl.wait_for_workers(timeout=60)))
+    waiter.start()
+
+    threads = [
+        threading.Thread(target=bad_worker, args=(
+            "wrong", {"type": "hello", "process_id": 0, "token": "nope"})),
+        threading.Thread(target=bad_worker, args=(
+            "missing", {"type": "hello", "process_id": 0})),
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not any(t.is_alive() for t in threads)
+
+    good = threading.Thread(target=good_worker, daemon=True)
+    good.start()
+    waiter.join(timeout=60)
+    assert accepted == [0]
+    ctrl.shutdown()
+    good.join(timeout=30)
+
+    assert results["wrong"]["type"] == "auth-failed"
+    assert results["missing"]["type"] == "auth-failed"
+    assert results["wrong_closed"] and results["missing_closed"]
+    assert results["good"]
+
+
+def test_worker_loop_exits_nonzero_on_auth_failure(capsys):
+    """The worker state machine turns an auth-failed reply into a non-zero
+    exit so a mis-tokened launch fails fast instead of hanging — while a
+    shutdown after a served cell still exits 0."""
+    srv, cli = socket.socketpair()
+    it.send_msg(srv, {"type": "auth-failed", "error": "bad token"})
+    assert it.worker_loop(cli, {}) == 1
+    assert "rejected" in capsys.readouterr().err
+    srv.close(), cli.close()
+
+    srv, cli = socket.socketpair()
+    it.send_msg(srv, {"type": "cell", "code": "1 + 1"})
+    it.send_msg(srv, {"type": "shutdown"})
+    assert it.worker_loop(cli, {}) == 0
+    assert it.recv_msg(srv)["value"] == "2"
+    srv.close(), cli.close()
 
 
 def _free_port():
@@ -134,6 +221,7 @@ def test_two_worker_interactive_session():
     base_env = dict(os.environ)
     base_env.pop("BLUEFOG_COORDINATOR", None)
     base_env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    base_env["BLUEFOG_SESSION_TOKEN"] = ctrl.token
     for pid in range(2):
         env = dict(base_env)
         env.update({

@@ -46,23 +46,27 @@ MODULES = [
 ]
 
 
+def _strip_addrs(text):
+    import re
+    # repr'd default objects embed memory addresses — nondeterministic
+    # churn on every regeneration (signatures AND dataclass auto-docstrings)
+    return re.sub(r" at 0x[0-9a-f]+", "", text)
+
+
 def _doc_head(obj, max_paras=1):
     doc = inspect.getdoc(obj)
     if not doc:
         return "*(no docstring)*"
     paras = doc.split("\n\n")
-    return "\n\n".join(paras[:max_paras]).strip()
+    return _strip_addrs("\n\n".join(paras[:max_paras]).strip())
 
 
 def _signature(obj):
-    import re
     try:
         sig = str(inspect.signature(obj))
     except (ValueError, TypeError):
         return "(...)"
-    # repr'd default objects embed memory addresses — nondeterministic
-    # churn on every regeneration
-    return re.sub(r" at 0x[0-9a-f]+", "", sig)
+    return _strip_addrs(sig)
 
 
 def _members(mod):

@@ -27,6 +27,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from . import ops
 from .parallel import context as _mesh
 from .schedule import CommSchedule, compile_from_weights
+from .utils import timeline as _tl
 
 __all__ = [
     "allreduce", "allgather", "ragged_allgather", "broadcast",
@@ -37,6 +38,14 @@ __all__ = [
 ]
 
 _jit_cache: Dict = {}
+
+
+def _dispatch(op_name, fn, *args):
+    """Dispatch one eager op under a host timeline span (no-op when the
+    timeline is off) — the per-op activities the reference's negotiation
+    loop records (``test/timeline_test.py:54-117``)."""
+    with _tl.op_span(op_name):
+        return fn(*args)
 
 
 def _cached(key, build):
@@ -178,7 +187,7 @@ def neighbor_allreduce(
             _per_rank(partial(ops.neighbor_allreduce, sched=sched,
                               axis="rank", wire=wire)),
             ctx.mesh))
-    return fn(x)
+    return _dispatch("neighbor_allreduce", fn, x)
 
 
 def neighbor_allgather(
@@ -205,7 +214,7 @@ def neighbor_allgather(
         lambda: _shard_map_1d(
             _per_rank(partial(ops.neighbor_allgather, sched=sched, axis="rank")),
             ctx.mesh))
-    return fn(x)
+    return _dispatch("neighbor_allgather", fn, x)
 
 
 def ragged_neighbor_allgather(
@@ -246,7 +255,7 @@ def ragged_neighbor_allgather(
         lambda: jax.jit(jax.shard_map(
             per_rank, mesh=ctx.mesh, in_specs=(P("rank"), P("rank")),
             out_specs=(P("rank"), P("rank")))))
-    return fn(x, lengths)
+    return _dispatch("ragged_neighbor_allgather", fn, x, lengths)
 
 
 def allreduce(x: jax.Array, average: bool = True) -> jax.Array:
@@ -258,7 +267,7 @@ def allreduce(x: jax.Array, average: bool = True) -> jax.Array:
         lambda: _shard_map_1d(
             _per_rank(partial(ops.allreduce, average=average, axis="rank")),
             ctx.mesh))
-    return fn(x)
+    return _dispatch("allreduce", fn, x)
 
 
 def allgather(x: jax.Array) -> jax.Array:
@@ -271,7 +280,7 @@ def allgather(x: jax.Array) -> jax.Array:
         ("ag", ctx.mesh, x.shape, x.dtype.name),
         lambda: _shard_map_1d(
             _per_rank(partial(ops.allgather, axis="rank")), ctx.mesh))
-    return fn(x)
+    return _dispatch("allgather", fn, x)
 
 
 def ragged_allgather(x: jax.Array, lengths) -> Tuple[jax.Array, jax.Array]:
@@ -301,7 +310,7 @@ def broadcast(x: jax.Array, root_rank: int) -> jax.Array:
         lambda: _shard_map_1d(
             _per_rank(partial(ops.broadcast, root_rank=root_rank, axis="rank")),
             ctx.mesh))
-    return fn(x)
+    return _dispatch("broadcast", fn, x)
 
 
 def pair_gossip(
@@ -323,7 +332,7 @@ def pair_gossip(
                 ops.pair_gossip, partners=tuple(int(p) for p in partners),
                 self_weight=self_weight, pair_weight=pair_weight, axis="rank")),
             ctx.mesh))
-    return fn(x)
+    return _dispatch("pair_gossip", fn, x)
 
 
 def hierarchical_neighbor_allreduce(
@@ -353,7 +362,7 @@ def hierarchical_neighbor_allreduce(
                 ops.hierarchical_neighbor_allreduce, machine_sched=sched,
                 machine_axis="machine", local_axis="local")),
             ctx.mesh_2d))
-    return fn(x)
+    return _dispatch("hierarchical_neighbor_allreduce", fn, x)
 
 
 # ---------------------------------------------------------------------------

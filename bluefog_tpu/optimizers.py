@@ -48,6 +48,7 @@ from . import fusion, ops
 from .ops import windows as wops
 from .parallel import context as _mesh
 from .schedule import CommSchedule
+from .utils.timeline import named_span
 
 Axis = str
 Communicator = Callable[[Any, jax.Array], Any]   # (params_pytree, step) -> pytree
@@ -94,7 +95,7 @@ def neighbor_communicator(
                 for s in schedules
             ]
             return lax.switch(step % len(schedules), branches, x)
-        with jax.named_scope("COMMUNICATE"):
+        with named_span("COMMUNICATE"):
             if fuse:
                 return fusion.fused_leaf_op(leaf)(params)
             return jax.tree.map(leaf, params)
@@ -134,7 +135,7 @@ def hierarchical_communicator(
                 for s in machine_schedules
             ]
             return lax.switch(step % len(machine_schedules), branches, xm)
-        with jax.named_scope("COMMUNICATE"):
+        with named_span("COMMUNICATE"):
             if fuse:
                 return fusion.fused_leaf_op(leaf)(params)
             return jax.tree.map(leaf, params)
@@ -145,7 +146,7 @@ def hierarchical_communicator(
 def allreduce_communicator(*, axis: Axis = "rank") -> Communicator:
     """Global parameter averaging (reference ``communication_type=allreduce``)."""
     def comm(params, step):
-        with jax.named_scope("COMMUNICATE"):
+        with named_span("COMMUNICATE"):
             return jax.tree.map(lambda x: lax.pmean(x, axis), params)
     return comm
 
@@ -192,7 +193,7 @@ def _apply(opt, grads, opt_state, params):
     # named scopes thread into HLO op metadata, so device traces show the
     # reference's activity names (COMMUNICATE/ADAPT) without user effort
     # (reference auto-annotation: torch/optimizers.py:112-163)
-    with jax.named_scope("ADAPT"):
+    with named_span("ADAPT"):
         updates, new_opt_state = opt.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), new_opt_state
 
@@ -219,7 +220,7 @@ def gradient_allreduce(
 
     def update(grads, state, params):
         reduce_ = lambda g: lax.pmean(g, axis)
-        with jax.named_scope("COMMUNICATE"):
+        with named_span("COMMUNICATE"):
             if fuse:
                 grads = fusion.fused_leaf_op(reduce_)(grads)
             else:
@@ -326,7 +327,7 @@ def _mailbox_optimizer(
 
         def communicate(operand):
             values, windows = operand
-            with jax.named_scope("COMMUNICATE"):
+            with named_span("COMMUNICATE"):
                 if carry_windows:
                     new_windows = _map_windows(
                         lambda w, x: leaf_comm(s, w, x, axis), windows, values)
@@ -477,7 +478,7 @@ def push_sum(
             _, w = wops.win_update_then_collect(w, s, axis=axis)
             return w                      # w.value is the mixed iterate
 
-        with jax.named_scope("COMMUNICATE"):
+        with named_span("COMMUNICATE"):
             windows = _map_windows(gossip, windows)
             mixed = _map_windows(lambda w: w.value, windows)
             p_windows = _map_windows(gossip, p_windows)
@@ -575,7 +576,7 @@ def choco_gossip(
         for buf, xh, sb in zip(fp.buffers, xhat, s):
             diff = buf - xh
             qd = _wire_decode(wire, _wire_encode(wire, diff), buf.dtype)
-            with jax.named_scope("COMMUNICATE"):
+            with named_span("COMMUNICATE"):
                 recv = ops.neighbor_allreduce(diff, s_zero, axis=axis,
                                               wire=wire)
             xh2 = xh + qd
@@ -656,13 +657,13 @@ def push_diging(
         u, p, y, g_prev = state.comm_state
         nar = lambda t: jax.tree.map(
             lambda x: ops.neighbor_allreduce(x, s, axis=axis), t)
-        with jax.named_scope("COMMUNICATE"):
+        with named_span("COMMUNICATE"):
             y = nar(y)
         y = jax.tree.map(lambda a, g, gp: a + g - gp, y, grads, g_prev)
-        with jax.named_scope("ADAPT"):
+        with named_span("ADAPT"):
             updates, opt_state = opt.update(y, state.opt_state, params)
         step_tree = _bufs(updates)
-        with jax.named_scope("COMMUNICATE"):
+        with named_span("COMMUNICATE"):
             u = nar(jax.tree.map(jnp.add, u, step_tree))
             p = nar(p)
         recipe = fusion.fuse_tree(params) if fuse else None
@@ -785,7 +786,7 @@ def _zero_apply(opt, grads, opt_state, params, axis: Axis, n: int):
     fg = fusion.fuse_tree(grads)
     fp = fusion.fuse_tree(params)
     g_shards, p_shards, pads = [], [], []
-    with jax.named_scope("COMMUNICATE"):       # reduce-scatter phase
+    with named_span("COMMUNICATE"):       # reduce-scatter phase
         for gbuf, pbuf in zip(fg.buffers, fp.buffers):
             pad = (-gbuf.size) % n
             gp = jnp.pad(gbuf, (0, pad))
@@ -797,11 +798,11 @@ def _zero_apply(opt, grads, opt_state, params, axis: Axis, n: int):
             p_shards.append(lax.dynamic_slice_in_dim(
                 pp, idx * shard.size, shard.size))
             pads.append(pad)
-    with jax.named_scope("ADAPT"):
+    with named_span("ADAPT"):
         updates, new_opt_state = opt.update(g_shards, opt_state, p_shards)
         new_shards = optax.apply_updates(p_shards, updates)
     new_bufs = []
-    with jax.named_scope("COMMUNICATE"):       # all-gather phase
+    with named_span("COMMUNICATE"):       # all-gather phase
         for shard, pad in zip(new_shards, pads):
             full = lax.all_gather(shard, axis, tiled=True)
             new_bufs.append(full[:full.size - pad] if pad else full)
@@ -809,9 +810,73 @@ def _zero_apply(opt, grads, opt_state, params, axis: Axis, n: int):
     return fp.unfuse(), new_opt_state
 
 
+def _check_elementwise_chain(opt: optax.GradientTransformation,
+                             n_probe: int = 2) -> None:
+    """Tripwire for the ZeRO elementwise requirement (see
+    :func:`zero_gradient_allreduce`): run ``opt.update`` once on a small
+    structured dummy tree (reference semantics) and once on emulated ZeRO
+    shard buffers (pad + split each fused dtype bucket across ``n_probe``
+    virtual ranks, one state shard each — exactly ``_zero_apply``'s
+    dataflow), and raise if the resulting parameters differ.
+
+    Catches the silent divergence of tree-coupled chains:
+    ``clip_by_global_norm`` computes a *per-shard* norm under ZeRO (each
+    rank only holds 1/n of the elements), ``masked``/``multi_transform``
+    see flat buffers instead of the labeled tree (usually a structure
+    error), per-leaf scalers (e.g. trust-ratio) see shard norms.  Plain
+    sgd/momentum/adam/adamw chains are elementwise and pass bit-for-bit.
+    """
+    tree_p = {"a": jnp.asarray([0.3, -0.4, 0.5], jnp.float32),
+              "b": jnp.asarray([[2.0, -1.0], [0.5, 3.0]], jnp.float32)}
+    tree_g = {"a": jnp.asarray([0.1, 0.2, -0.3], jnp.float32),
+              "b": jnp.asarray([[-1.0, 0.4], [0.2, 2.0]], jnp.float32)}
+    why = None
+    try:
+        ref_upd, _ = opt.update(tree_g, opt.init(tree_p), tree_p)
+        ref_new = optax.apply_updates(tree_p, ref_upd)
+
+        fp, fg = fusion.fuse_tree(tree_p), fusion.fuse_tree(tree_g)
+        pads = [(-buf.size) % n_probe for buf in fp.buffers]
+        p_pad = [jnp.pad(b, (0, p)) for b, p in zip(fp.buffers, pads)]
+        g_pad = [jnp.pad(b, (0, p)) for b, p in zip(fg.buffers, pads)]
+        shards_new = []
+        for i in range(n_probe):
+            sl = lambda b: lax.dynamic_slice_in_dim(
+                b, i * (b.size // n_probe), b.size // n_probe)
+            p_sh = [sl(b) for b in p_pad]
+            g_sh = [sl(b) for b in g_pad]
+            st = opt.init([jnp.zeros_like(b) for b in p_sh])
+            upd, _ = opt.update(g_sh, st, p_sh)
+            shards_new.append(optax.apply_updates(p_sh, upd))
+        new_bufs = [
+            jnp.concatenate([shards_new[i][k] for i in range(n_probe)])
+            for k in range(len(p_pad))]
+        fp.buffers = [b[:b.size - p] if p else b
+                      for b, p in zip(new_bufs, pads)]
+        zero_new = fp.unfuse()
+        agree = all(
+            np.allclose(np.asarray(a), np.asarray(b), rtol=2e-5, atol=1e-6)
+            for a, b in zip(jax.tree.leaves(ref_new),
+                            jax.tree.leaves(zero_new)))
+        if not agree:
+            why = ("probe trajectories differ between the structured tree "
+                   "and ZeRO shard buffers")
+    except Exception as exc:                    # structure errors etc.
+        why = f"probe failed on ZeRO shard buffers: {exc!r}"
+    if why:
+        raise ValueError(
+            "this optax chain is not elementwise, so ZeRO-1 sharding would "
+            f"silently diverge from gradient_allreduce ({why}). Transforms "
+            "that couple elements across the tree (clip_by_global_norm, "
+            "masked, multi_transform, per-leaf trust ratios) see per-shard "
+            "buffers under ZeRO, not the full tree. Use gradient_allreduce, "
+            "move the coupling into grad_fn, or pass "
+            "check_elementwise=False if you know the chain is exact.")
+
+
 def zero_gradient_allreduce(
     opt: optax.GradientTransformation, *, axis: Axis = "rank",
-    axis_size: Optional[int] = None,
+    axis_size: Optional[int] = None, check_elementwise: bool = True,
 ) -> DecentralizedOptimizer:
     """Synchronous data parallelism with ZeRO-1 sharded optimizer state.
 
@@ -839,7 +904,11 @@ def zero_gradient_allreduce(
 
     ``axis_size`` overrides the context lookup (for AOT compilation against
     an abstract topology where no context is initialized).
+    ``check_elementwise=False`` skips the construction-time probe
+    (:func:`_check_elementwise_chain`) that rejects tree-coupled chains.
     """
+    if check_elementwise:
+        _check_elementwise_chain(opt)
     n = axis_size or _zero_axis_size(axis)
     axes = ("rank",) if axis == "rank" else ("machine", "local")
 
@@ -862,6 +931,7 @@ def zero_adapt_with_combine(
     shard_axis: Axis = "local",
     axes: Tuple[str, ...] = ("machine", "local"),
     shard_axis_size: Optional[int] = None,
+    check_elementwise: bool = True,
 ) -> DecentralizedOptimizer:
     """Hierarchical gossip with ZeRO sharding on the orthogonal axis.
 
@@ -881,8 +951,12 @@ def zero_adapt_with_combine(
 
     Shares :func:`zero_gradient_allreduce`'s hard requirement: the optax
     chain must be elementwise (the adapt sees flat shard buffers, not the
-    param pytree — tree-structured or global-norm transforms diverge).
+    param pytree — tree-structured or global-norm transforms diverge), and
+    the same construction-time tripwire enforces it
+    (``check_elementwise=False`` to skip).
     """
+    if check_elementwise:
+        _check_elementwise_chain(opt)
     n = shard_axis_size or _zero_axis_size(shard_axis)
 
     def init(params):
@@ -1056,7 +1130,7 @@ def _stateful_per_rank(grad_fn, strategy, steps_per_call, sync):
             lambda x: x[0], (params, net_state, dstate, batch))
 
         def one(p, ns, s, b):
-            with jax.named_scope("GRADIENT"):
+            with named_span("GRADIENT"):
                 loss, grads, ns = grad_fn(p, ns, b)
             ns = sync(ns)
             p, s = strategy.update(grads, s, p)
@@ -1130,7 +1204,7 @@ def make_stateful_train_step(
                 return ops.neighbor_allreduce(x, s, axis="rank")
             return lax.pmean(x, "rank")
 
-        with jax.named_scope("STATE_SYNC"):
+        with named_span("STATE_SYNC"):
             return jax.tree.map(leaf, ns)
 
     inner = _stateful_per_rank(grad_fn, strategy, steps_per_call, sync)

@@ -186,8 +186,12 @@ def get_context() -> BlueFogTpuContext:
 
 
 def shutdown() -> None:
-    """Drop the context (reference: ``bf.shutdown``)."""
+    """Drop the context (reference: ``bf.shutdown``) — flushing any active
+    timeline first, as the reference's shutdown drains its writer thread
+    (``operations.cc:464-473``)."""
     global _context
+    from ..utils.timeline import stop_timeline
+    stop_timeline()
     with _lock:
         _context = None
 

@@ -40,18 +40,27 @@ _open_spans: Dict[str, list] = {}
 _path_prefix: Optional[str] = None
 _profiler_active = False
 _native_active = False
+_atexit_registered = False
 
 
 def start_timeline(path_prefix: str, with_device_trace: bool = True) -> bool:
     """Begin collecting a timeline (reference: timeline file per rank,
-    ``operations.cc:464-473``; here one file per process)."""
-    global _path_prefix, _profiler_active, _native_active
+    ``operations.cc:464-473``; here one file per process).
+
+    The artifact flushes on :func:`stop_timeline`, on ``bf.shutdown()``, or
+    at interpreter exit (atexit) — whichever comes first — so scripts that
+    just set ``BLUEFOG_TIMELINE`` and run still produce the file."""
+    global _path_prefix, _profiler_active, _native_active, _atexit_registered
     with _lock:
         if _path_prefix is not None:
             return False
         _path_prefix = path_prefix
         _events.clear()
         _open_spans.clear()
+        if not _atexit_registered:
+            import atexit
+            atexit.register(stop_timeline)
+            _atexit_registered = True
     # Prefer the native writer (C++ ring buffer + flush thread — the
     # reference's TimelineWriter design); fall back to the in-process list.
     out = path_prefix + ".activities.json"
@@ -137,6 +146,37 @@ def timeline_context(tensor_name: str, activity_name: str = "ACTIVITY"):
         yield
     finally:
         timeline_end_activity(tensor_name)
+
+
+@contextlib.contextmanager
+def op_span(tensor_name: str, activity_name: str = "COMMUNICATE"):
+    """Host span for one eager op call, zero-cost when the timeline is off.
+
+    Wraps the blocking-op API (``bf.neighbor_allreduce`` et al.) so each call
+    lands one activity in ``<prefix>.activities.json`` — the per-op spans the
+    reference's timeline records from the negotiation loop
+    (``test/timeline_test.py:54-117``).  The span covers host dispatch; the
+    on-device time of the same op is in the ``.device_trace`` profile."""
+    if _path_prefix is None:
+        yield
+    else:
+        with timeline_context(tensor_name, activity_name):
+            yield
+
+
+@contextlib.contextmanager
+def named_span(activity_name: str, tensor_name: str = "train_step"):
+    """``jax.named_scope`` (threads the activity name into HLO metadata, so
+    device traces label COMMUNICATE/ADAPT regions) plus, when the timeline
+    is active, a host activity span.  Inside ``jit`` the host span records
+    *trace-time* cost — it fires once, at compilation; steady-state timing
+    for these regions lives in the device trace under the same name."""
+    with jax.named_scope(activity_name):
+        if _path_prefix is None:
+            yield
+        else:
+            with timeline_context(tensor_name, activity_name):
+                yield
 
 
 def maybe_start_from_env() -> None:

@@ -1,0 +1,120 @@
+"""Timeline integration: real ops emit the expected activities.
+
+Port of the reference's ``test/timeline_test.py:54-117``, which runs real
+collectives with the timeline enabled and asserts the emitted JSON contains
+the expected activity spans per tensor.  Here the timeline is enabled via
+the same ``BLUEFOG_TIMELINE`` hook ``bf.init`` honors, one CTA train step
+plus eager blocking ops run, and ``<prefix>.activities.json`` must contain:
+
+* ``COMMUNICATE`` / ``ADAPT`` spans from the optimizer strategy's named
+  scopes (trace-time host spans; the same names label the device trace);
+* ``STATE_SYNC`` when a stateful step runs with ``state_sync=`` enabled;
+* one per-op span per eager blocking call, named after the op.
+"""
+import json
+
+import jax
+import jax.numpy as jnp
+import optax
+import pytest
+
+import bluefog_tpu as bf
+from bluefog_tpu import optimizers as bfopt
+from bluefog_tpu import topology as tu
+from bluefog_tpu.utils import timeline as tl
+
+N, D = 8, 4
+
+
+@pytest.fixture
+def ctx(cpu_devices):
+    bf.init(devices=cpu_devices)
+    bf.set_topology(tu.ExponentialTwoGraph(N), is_weighted=True)
+    yield
+    bf.shutdown()
+
+
+def grad_fn(params, batch):
+    loss = jnp.mean((params["w"] - batch) ** 2)
+    return loss, jax.grad(lambda p: jnp.mean((p["w"] - batch) ** 2))(params)
+
+
+def _load_events(path):
+    with open(path) as f:
+        doc = json.load(f)
+    return doc["traceEvents"]
+
+
+def test_cta_step_and_eager_ops_emit_activities(ctx, tmp_path, monkeypatch):
+    prefix = str(tmp_path / "tl")
+    monkeypatch.setenv("BLUEFOG_TIMELINE", prefix)
+    # the exact hook bf.init runs when BLUEFOG_TIMELINE is set
+    tl.maybe_start_from_env()
+    try:
+        strat = bfopt.DistributedAdaptWithCombineOptimizer(
+            optax.sgd(0.05), communication_type="neighbor_allreduce")
+        params = bfopt.replicate({"w": jnp.zeros((D,), jnp.float32)})
+        state = bfopt.init_distributed(strat, params)
+        step = bfopt.make_train_step(grad_fn, strat)
+        batch = jnp.broadcast_to(
+            jnp.arange(float(N))[:, None], (N, D)).astype(jnp.float32)
+        params, state, loss = step(params, state, batch)
+        jax.block_until_ready(loss)
+
+        # eager blocking ops record one span per call, named after the op
+        x = bf.shard_distributed(batch)
+        bf.synchronize(bf.neighbor_allreduce(x))
+        bf.synchronize(bf.allreduce(x))
+        bf.synchronize(bf.broadcast(x, 0))
+    finally:
+        out = tl.stop_timeline()
+
+    events = _load_events(out)
+    names = {e["name"] for e in events}
+    # the reference asserts per-op activity names in the artifact
+    # (test/timeline_test.py:54-117); COMMUNICATE/ADAPT are its
+    # MPI-op/optimizer span names
+    assert "COMMUNICATE" in names, names
+    assert "ADAPT" in names, names
+    cats = {e.get("cat") for e in events}
+    assert {"neighbor_allreduce", "allreduce", "broadcast"} <= cats, cats
+    # spans are well-formed complete events
+    for e in events:
+        assert e["ph"] == "X" and e["dur"] >= 0
+
+
+def test_stateful_step_emits_state_sync(ctx, tmp_path):
+    prefix = str(tmp_path / "tl_sync")
+    assert tl.start_timeline(prefix, with_device_trace=False)
+    try:
+        strat = bfopt.DistributedAdaptWithCombineOptimizer(
+            optax.sgd(0.05), communication_type="neighbor_allreduce")
+
+        def sgrad_fn(params, net_state, batch):
+            loss = jnp.mean((params["w"] - batch) ** 2)
+            g = jax.grad(lambda p: jnp.mean((p["w"] - batch) ** 2))(params)
+            return loss, g, {"ema": 0.9 * net_state["ema"] + 0.1 * loss}
+
+        params = bfopt.replicate({"w": jnp.zeros((D,), jnp.float32)})
+        net_state = bfopt.replicate({"ema": jnp.zeros((), jnp.float32)})
+        state = bfopt.init_distributed(strat, params)
+        step = bfopt.make_stateful_train_step(
+            sgrad_fn, strat, state_sync="neighbor")
+        batch = jnp.broadcast_to(
+            jnp.arange(float(N))[:, None], (N, D)).astype(jnp.float32)
+        params, net_state, state, loss = step(params, net_state, state, batch)
+        jax.block_until_ready(loss)
+    finally:
+        out = tl.stop_timeline()
+
+    names = {e["name"] for e in _load_events(out)}
+    assert "STATE_SYNC" in names, names
+    assert "COMMUNICATE" in names and "ADAPT" in names, names
+
+
+def test_timeline_off_means_no_artifact(ctx, tmp_path):
+    """When the timeline is off the op API takes the zero-cost path (no
+    spans buffered, stop returns None)."""
+    x = bf.shard_distributed(jnp.ones((N, D), jnp.float32))
+    bf.synchronize(bf.neighbor_allreduce(x))
+    assert tl.stop_timeline() is None

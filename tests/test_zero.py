@@ -136,6 +136,33 @@ def test_zero_single_rank_degenerate(cpu_devices):
     np.testing.assert_allclose(np.asarray(params["w"]), 0.8 * np.ones((1, 3)))
 
 
+def test_zero_rejects_tree_coupled_chains():
+    """The construction-time tripwire converts the documented elementwise
+    requirement into a loud error: chains that couple elements across the
+    tree (global-norm clipping, masked/multi_transform) would silently
+    diverge from gradient_allreduce under sharding (round-3 review item)."""
+    clip_chain = optax.chain(optax.clip_by_global_norm(0.1), optax.sgd(0.05))
+    with pytest.raises(ValueError, match="not elementwise"):
+        bfopt.zero_gradient_allreduce(clip_chain)
+    masked = optax.masked(optax.sgd(0.05), {"w": True, "w16": False})
+    with pytest.raises(ValueError, match="not elementwise"):
+        bfopt.zero_gradient_allreduce(masked)
+    comm = bfopt.hierarchical_communicator(bf.machine_schedule())
+    with pytest.raises(ValueError, match="not elementwise"):
+        bfopt.zero_adapt_with_combine(clip_chain, comm)
+    # the documented escape hatch still constructs
+    strat = bfopt.zero_gradient_allreduce(clip_chain, check_elementwise=False)
+    assert strat.axes == ("rank",)
+
+
+def test_zero_tripwire_passes_elementwise_chains():
+    """sgd/momentum/adam/adamw construct cleanly (and the equivalence test
+    above keeps pinning that they are exact under sharding)."""
+    for opt in (optax.sgd(0.05), optax.sgd(0.05, momentum=0.9),
+                optax.adam(1e-3), optax.adamw(1e-3)):
+        bfopt.zero_gradient_allreduce(opt)
+
+
 def test_zero_local_axis_plumbs_2d_mesh():
     """zero_gradient_allreduce(axis='local'): per-machine synchronous DP
     with no cross-machine traffic — the strategy must carry the 2-D axes so

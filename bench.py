@@ -256,6 +256,7 @@ def run_bench(on_accelerator: bool, probe_info: dict) -> dict:
         "value": round(per_chip, 2),
         "unit": "img/s/chip",
         "vs_baseline": round(per_chip / BASELINE_PER_GPU, 3),
+        "ok": True,                   # a real measurement, not a rescue line
         "on_accelerator": on_accelerator,
         "device": device_kind,
         "n_chips": n,
@@ -293,11 +294,11 @@ def _cpu_fallback_subprocess(probe_info: dict, reason: str,
     # artifact — the rescue line in main() handles that case instead
     lines = [ln for ln in p.stdout.splitlines() if ln.strip()]
     try:
-        json.loads(lines[-1])
+        doc = json.loads(lines[-1])
     except (IndexError, ValueError):
-        return p.returncode, False
+        return p.returncode, None
     print(lines[-1])
-    return p.returncode, True
+    return p.returncode, doc
 
 
 def main():
@@ -322,9 +323,9 @@ def main():
         import traceback
         traceback.print_exc()
         reason = f"{type(e).__name__}: {e}"
-        rc, got_json = _cpu_fallback_subprocess(
+        rc, doc = _cpu_fallback_subprocess(
             probe_info, reason, orig_xla_flags)
-        if not got_json:
+        if doc is None:
             # the fallback died without printing valid JSON (e.g. killed by
             # a native abort) — the contract is one valid line no matter what
             print(json.dumps({
@@ -332,10 +333,16 @@ def main():
                 "value": 0.0,
                 "unit": "img/s/chip",
                 "vs_baseline": 0.0,
+                "ok": False,
                 "error": reason[:400],
                 "fallback_rc": rc,
                 **probe_info,
             }))
+        # a doubly-failed run must not read as a successful measurement:
+        # exit non-zero whenever the landed artifact is a rescue line
+        # (round-3 advisor item — drivers checking exit status alone)
+        if doc is None or not doc.get("ok", False):
+            sys.exit(1)
 
 
 if __name__ == "__main__":
@@ -351,5 +358,7 @@ if __name__ == "__main__":
             "value": 0.0,
             "unit": "img/s/chip",
             "vs_baseline": 0.0,
+            "ok": False,
             "error": f"{type(e).__name__}: {e}"[:400],
         }))
+        sys.exit(1)                 # rescue artifact, not a measurement

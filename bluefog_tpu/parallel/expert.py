@@ -113,23 +113,44 @@ def moe_apply_topk(
     *,
     capacity: int,
     axis: Axis = "expert",
+    fused: bool = True,
 ) -> jax.Array:
-    """Top-k routed MoE layer (k=2 is the classic mixture): each choice
-    dispatches independently (k all_to_all round trips) and the outputs
-    combine under the router's gates.  Dropped slots contribute zero, so
-    a token over capacity in one choice still receives its other experts'
-    gated outputs — the standard static-capacity top-k semantics.
+    """Top-k routed MoE layer (k=2 is the classic mixture): the k choices
+    are stacked into ONE dispatch/combine — a single all_to_all round trip
+    and a single expert invocation regardless of k (round-3 advisor item;
+    the unfused path cost k sequential round trips).  Dropped slots
+    contribute zero, so a token over capacity in one choice still receives
+    its other experts' gated outputs.
+
+    Capacity accounting is *shared*: each (source device, expert) pair gets
+    ``k * capacity`` slots pooled across the k choices, filled choice-major
+    (every token's first choice outranks any second choice — the GShard
+    priority), so one choice's slack can absorb another's overflow.  With
+    ample capacity this is bit-identical to the per-choice scheme;
+    ``fused=False`` restores the exact independent-dispatch semantics
+    (k round trips, ``capacity`` slots per choice).
     """
     if topk_idx.ndim != 2 or topk_idx.shape != topk_gate.shape:
         raise ValueError(
             f"topk_idx/topk_gate must both be [tokens, k], got "
             f"{topk_idx.shape} / {topk_gate.shape}")
-    y = jnp.zeros_like(x)
-    for j in range(topk_idx.shape[1]):
-        out = moe_apply(x, topk_idx[:, j], expert_fn, expert_params,
-                        capacity=capacity, axis=axis)
-        y = y + out * topk_gate[:, j:j + 1].astype(x.dtype)
-    return y
+    T, D = x.shape
+    k = topk_idx.shape[1]
+    if not fused:
+        y = jnp.zeros_like(x)
+        for j in range(k):
+            out = moe_apply(x, topk_idx[:, j], expert_fn, expert_params,
+                            capacity=capacity, axis=axis)
+            y = y + out * topk_gate[:, j:j + 1].astype(x.dtype)
+        return y
+    # choice-major virtual tokens [c0t0.. c0tN, c1t0..]: first choices claim
+    # slots before any second choice (the cumsum in _routing is the queue)
+    x_rep = jnp.tile(x, (k, 1))                          # [k*T, D]
+    flat_idx = topk_idx.T.reshape(k * T)
+    out = moe_apply(x_rep, flat_idx, expert_fn, expert_params,
+                    capacity=k * capacity, axis=axis)    # one round trip
+    gates = topk_gate.T[..., None].astype(x.dtype)       # [k, T, 1]
+    return jnp.sum(out.reshape(k, T, D) * gates, axis=0)
 
 
 def load_balancing_loss(router_probs: jax.Array,

@@ -101,6 +101,57 @@ def test_topk_combines_gated_experts(cpu_devices):
     np.testing.assert_allclose(out, expected, rtol=1e-5, atol=1e-6)
 
 
+def test_topk_fused_matches_unfused_when_capacity_ample(cpu_devices):
+    """With no drops the fused single-round-trip dispatch is numerically
+    identical to k independent dispatches."""
+    mesh = Mesh(np.array(cpu_devices[:E]), ("expert",))
+    rng = np.random.default_rng(7)
+    x = jnp.asarray(rng.normal(size=(E, T, D)), jnp.float32)
+    idx = jnp.asarray(rng.integers(0, E, size=(E, T, 2)), jnp.int32)
+    gate = jnp.asarray(rng.uniform(0.2, 0.8, size=(E, T, 2)), jnp.float32)
+
+    def make(fused):
+        def f(xb, ib, gb):
+            eid = jax.lax.axis_index("expert").astype(jnp.float32)
+            return moe_apply_topk(xb[0], ib[0], gb[0],
+                                  lambda p, t: t * (p + 1.0), eid,
+                                  capacity=2 * T, axis="expert",
+                                  fused=fused)[None]
+        return jax.jit(jax.shard_map(
+            f, mesh=mesh, in_specs=(P("expert"),) * 3,
+            out_specs=P("expert")))
+
+    np.testing.assert_allclose(np.asarray(make(True)(x, idx, gate)),
+                               np.asarray(make(False)(x, idx, gate)),
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_topk_fused_shares_capacity_choice_major(cpu_devices):
+    """Shared accounting, first choices first: every token names expert 0
+    twice with per-choice capacity 2 — the pooled 2*2 slots serve ALL four
+    first choices (the per-choice scheme would serve 2+2 split across
+    choices); every second choice is dropped."""
+    mesh = Mesh(np.array(cpu_devices[:E]), ("expert",))
+    T_ = 4
+    x = jnp.ones((E, T_, D), jnp.float32)
+    idx = jnp.zeros((E, T_, 2), jnp.int32)
+    gate = jnp.concatenate([jnp.full((E, T_, 1), 0.75),
+                            jnp.full((E, T_, 1), 0.25)], axis=-1)
+
+    def f(xb, ib, gb):
+        eid = jax.lax.axis_index("expert").astype(jnp.float32)
+        return moe_apply_topk(xb[0], ib[0], gb[0],
+                              lambda p, t: t * (p + 1.0), eid,
+                              capacity=2, axis="expert")[None]
+
+    fn = jax.jit(jax.shard_map(
+        f, mesh=mesh, in_specs=(P("expert"),) * 3, out_specs=P("expert")))
+    out = np.asarray(fn(x, idx, gate))
+    # expert 0 scales by 1.0; all 4 first choices (gate .75) served, all
+    # second choices (gate .25) dropped
+    np.testing.assert_allclose(out, 0.75 * np.ones((E, T_, D)), rtol=1e-6)
+
+
 def test_topk_shape_mismatch_raises():
     with pytest.raises(ValueError, match="tokens, k"):
         moe_apply_topk(jnp.zeros((4, 2)), jnp.zeros((4, 2), jnp.int32),

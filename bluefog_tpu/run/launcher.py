@@ -8,9 +8,18 @@ the pod metadata (coordinator, process count, local devices) from the
 environment.  This launcher keeps the familiar CLI surface:
 
     bfrun-tpu -np 4 python train.py            # 4 local processes (CPU/dev)
+    bfrun-tpu -H host1,host2:2 python train.py # SSH fan-out: start all ranks
     bfrun-tpu --coordinator host0:1234 --num-processes 16 --process-id 3 \
         python train.py                        # explicit multi-host bootstrap
     bfrun-tpu python train.py                  # TPU pod: auto-detect
+
+The ``-H`` fan-out (reference: ``bfrun -H`` + mpirun's remote spawn,
+``run.py:133-198``) SSHes to each host and starts its ranks with the
+``jax.distributed`` bootstrap env — coordinator on the first host, dense
+process ids in host order, ``BLUEFOG_*``/``JAX_*``/``XLA_*``/``TPU_*``
+forwarded.  On TPU pods prefer the no-flag auto-detect (the pod metadata
+already carries all of this); ``-H`` is for DCN clusters and CPU/GPU
+fleets without a pod runtime.
 
 Env forwarding matches bfrun's ``-x``/env behavior: the child inherits the
 environment plus BLUEFOG_* variables are always passed through.
@@ -38,6 +47,17 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-np", "--num-local-processes", type=int, default=None,
                    help="spawn N local processes with a virtual device split "
                         "(testing/CPU; reference: bfrun -np)")
+    p.add_argument("-H", "--hosts", default=None,
+                   help="comma-separated remote hosts, each optionally "
+                        "host:slots (processes on that host, default 1): "
+                        "one SSH fan-out starts every rank with the "
+                        "jax.distributed bootstrap env (reference: bfrun "
+                        "-H + mpirun's remote spawn, run.py:133-198)")
+    p.add_argument("--ssh-port", type=int, default=None,
+                   help="SSH port for -H fan-out")
+    p.add_argument("--remote-shell", default="ssh",
+                   help="remote-spawn command for -H (default ssh; tests "
+                        "substitute a local stub)")
     p.add_argument("--coordinator", default=None,
                    help="coordinator address host:port for jax.distributed")
     p.add_argument("--num-processes", type=int, default=None,
@@ -98,6 +118,113 @@ def _child_env(args) -> dict:
                 and "xla_tpu_enable_async_collective_fusion" not in flags):
             env["XLA_FLAGS"] = (RECOMMENDED_TPU_XLA_FLAGS + " " + flags).strip()
     return env
+
+
+def parse_hosts(spec: str):
+    """``"host1,host2:2"`` -> ``[("host1", 1), ("host2", 2)]``."""
+    out = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        host, _, slots = part.partition(":")
+        out.append((host, int(slots) if slots else 1))
+    if not out:
+        raise SystemExit("-H needs at least one host")
+    return out
+
+
+# env the remote ranks need even without explicit -x (reference: bfrun
+# forwards every exportable variable through mpirun -x; here the relevant
+# namespaces are forwarded and -x adds the rest)
+_FORWARD_PREFIXES = ("BLUEFOG_", "JAX_", "XLA_", "TPU_", "LIBTPU_")
+
+
+def build_multihost_plan(hosts, command, *, cwd, coordinator=None,
+                         base_env=None, extra_env=(), remote_shell="ssh",
+                         ssh_port=None):
+    """Build one remote-spawn argv per rank for the ``-H`` fan-out.
+
+    Each rank's remote command cds into the launch directory and execs the
+    training command under the ``jax.distributed`` bootstrap env
+    (coordinator on the first host, dense process ids in host order) plus
+    the forwarded ``BLUEFOG_*``/``JAX_*``/``XLA_*``/``TPU_*`` variables and
+    any ``-x NAME=VALUE`` extras — the reference's env-forwarding contract
+    (``run.py:184-196``) without the mpirun dependency.
+    """
+    base_env = dict(base_env or {})
+    total = sum(s for _, s in hosts)
+    coordinator = coordinator or f"{hosts[0][0]}:48292"
+    forwarded = {k: v for k, v in base_env.items()
+                 if k.startswith(_FORWARD_PREFIXES)
+                 and k not in ("BLUEFOG_COORDINATOR", "BLUEFOG_PROCESS_ID",
+                               "BLUEFOG_NUM_PROCESSES",
+                               # never embed secrets in the ssh argv (it is
+                               # visible in `ps` on both ends); interactive
+                               # sessions distribute their token themselves
+                               "BLUEFOG_SESSION_TOKEN")}
+    for kv in extra_env:
+        k, _, v = kv.partition("=")
+        forwarded[k] = v
+    plans = []
+    pid = 0
+    for host, slots in hosts:
+        for _ in range(slots):
+            env_pairs = {
+                **forwarded,
+                "BLUEFOG_COORDINATOR": coordinator,
+                "BLUEFOG_NUM_PROCESSES": str(total),
+                "BLUEFOG_PROCESS_ID": str(pid),
+            }
+            remote_cmd = "cd {} && exec env {} {}".format(
+                shlex.quote(cwd),
+                " ".join(f"{k}={shlex.quote(v)}"
+                         for k, v in sorted(env_pairs.items())),
+                " ".join(shlex.quote(c) for c in command))
+            argv = shlex.split(remote_shell)
+            if ssh_port is not None:
+                argv += ["-p", str(ssh_port)]
+            argv += [host, remote_cmd]
+            plans.append((host, pid, argv))
+            pid += 1
+    return plans
+
+
+def _multihost_fanout(args, env) -> int:
+    """``bfrun-tpu -H host1,host2 python train.py``: start every rank over
+    SSH, stream their output, propagate the first failure — the one-command
+    multi-host launch the reference gets from mpirun's remote spawn."""
+    hosts = parse_hosts(args.hosts)
+    plans = build_multihost_plan(
+        hosts, args.command, cwd=os.getcwd(),
+        coordinator=args.coordinator, base_env=env, extra_env=args.env,
+        remote_shell=args.remote_shell, ssh_port=args.ssh_port)
+    procs = []
+    for host, pid, argv in plans:
+        print(f"bfrun-tpu: starting rank {pid} on {host}", flush=True)
+        procs.append(subprocess.Popen(argv))
+    # first failure kills the survivors (mpirun semantics): a dead rank
+    # leaves the others blocked in jax.distributed collectives forever
+    import time as _time
+    failure = None
+    while failure is None and any(p.poll() is None for p in procs):
+        failure = next((p.returncode for p in procs
+                        if p.returncode not in (None, 0)), None)
+        if failure is None:
+            _time.sleep(0.2)
+    if failure is None:
+        failure = next((p.returncode for p in procs if p.returncode), None)
+    if failure is not None:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        return failure
+    return 0
 
 
 def _interactive_cluster(args, env) -> int:
@@ -209,6 +336,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         cmd = cmd[1:]
 
     env = _child_env(args)
+
+    if args.hosts:
+        args.command = cmd
+        return _multihost_fanout(args, env)
 
     if args.num_local_processes:
         # local multi-process emulation: each process sees a slice of a

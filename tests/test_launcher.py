@@ -57,3 +57,114 @@ def test_coordinator_requires_process_id():
     with pytest.raises(SystemExit):
         launcher.main(["--coordinator", "h:1", "--num-processes", "2",
                        "true"])
+
+
+def test_parse_hosts():
+    assert launcher.parse_hosts("h1,h2:2, h3:4") == [
+        ("h1", 1), ("h2", 2), ("h3", 4)]
+    with pytest.raises(SystemExit):
+        launcher.parse_hosts(" , ")
+
+
+def test_multihost_plan_command_lines_and_env(monkeypatch):
+    """-H fan-out: one remote argv per rank, dense process ids in host
+    order, coordinator defaulting to the first host, namespaced env +
+    -x extras forwarded, cwd preserved (reference: run.py:133-198)."""
+    plans = launcher.build_multihost_plan(
+        [("h1", 1), ("h2", 2)], ["python", "train.py", "--lr", "0.1"],
+        cwd="/work dir", base_env={"JAX_PLATFORMS": "tpu", "HOME": "/root",
+                                   "BLUEFOG_PROCESS_ID": "9"},
+        extra_env=["FOO=a b"], remote_shell="ssh", ssh_port=2222)
+    assert [(h, p) for h, p, _ in plans] == [("h1", 0), ("h2", 1), ("h2", 2)]
+    for i, (host, pid, argv) in enumerate(plans):
+        assert argv[:3] == ["ssh", "-p", "2222"]
+        assert argv[3] == host
+        remote = argv[4]
+        assert remote.startswith("cd '/work dir' && exec env ")
+        assert f"BLUEFOG_PROCESS_ID={i}" in remote
+        assert "BLUEFOG_NUM_PROCESSES=3" in remote
+        assert "BLUEFOG_COORDINATOR=h1:48292" in remote
+        assert "JAX_PLATFORMS=tpu" in remote
+        assert "FOO='a b'" in remote
+        assert "HOME=" not in remote              # only namespaced env
+        assert "BLUEFOG_PROCESS_ID=9" not in remote   # bootstrap wins
+        assert remote.endswith("python train.py --lr 0.1")
+    # explicit coordinator overrides the first-host default
+    plans = launcher.build_multihost_plan(
+        [("h1", 1)], ["true"], cwd="/", coordinator="c0:7777")
+    assert "BLUEFOG_COORDINATOR=c0:7777" in plans[0][2][-1]
+
+
+def test_multihost_fanout_e2e_with_stub_shell(tmp_path):
+    """main() with -H drives the full fan-out through a stub remote shell
+    (records '<host> <remote command>' then runs it locally via sh), so the
+    spawned 'remote' ranks really execute with the bootstrap env."""
+    import os
+    import subprocess
+    import sys
+    stub = tmp_path / "fake_ssh"
+    log = tmp_path / "calls.log"
+    stub.write_text(
+        "#!/bin/sh\n"
+        f'echo "$@" >> {log}\n'
+        'host="$1"; shift\n'
+        'exec sh -c "$@"\n')
+    stub.chmod(0o755)
+    out = tmp_path / "ranks"
+    code = launcher.main(
+        ["-H", "hostA,hostB", "--remote-shell", str(stub), "--",
+         sys.executable, "-c",
+         "import os,pathlib; pathlib.Path("
+         f"'{out}' + os.environ['BLUEFOG_PROCESS_ID']).write_text("
+         "os.environ['BLUEFOG_NUM_PROCESSES'] + ' ' + "
+         "os.environ['BLUEFOG_COORDINATOR'])"])
+    assert code == 0
+    calls = log.read_text().splitlines()
+    # ranks launch concurrently; the stub's log order is nondeterministic
+    assert sorted(c.split()[0] for c in calls) == ["hostA", "hostB"]
+    assert (out.parent / "ranks0").read_text() == "2 hostA:48292"
+    assert (out.parent / "ranks1").read_text() == "2 hostA:48292"
+
+
+def test_multihost_fanout_propagates_failure(tmp_path):
+    import sys
+    stub = tmp_path / "fake_ssh"
+    stub.write_text('#!/bin/sh\nshift\nexec sh -c "$@"\n')
+    stub.chmod(0o755)
+    code = launcher.main(
+        ["-H", "h1,h2", "--remote-shell", str(stub), "--",
+         sys.executable, "-c",
+         "import os,sys; sys.exit(3 if os.environ['BLUEFOG_PROCESS_ID'] "
+         "== '1' else 0)"])
+    assert code == 3
+
+
+def test_multihost_fanout_kills_survivors_on_failure(tmp_path):
+    """mpirun semantics: when one rank dies the others (blocked in
+    collectives forever in real launches) are terminated, not awaited."""
+    import sys
+    import time
+    stub = tmp_path / "fake_ssh"
+    stub.write_text('#!/bin/sh\nshift\nexec sh -c "$@"\n')
+    stub.chmod(0o755)
+    t0 = time.perf_counter()
+    code = launcher.main(
+        ["-H", "h1,h2", "--remote-shell", str(stub), "--",
+         sys.executable, "-c",
+         "import os,sys,time\n"
+         "sys.exit(2) if os.environ['BLUEFOG_PROCESS_ID'] == '0' "
+         "else time.sleep(600)"])
+    assert code == 2
+    assert time.perf_counter() - t0 < 60      # did not wait out the sleeper
+
+
+def test_multihost_plan_never_embeds_session_token(monkeypatch):
+    """The ssh argv is visible in `ps` on both ends — the interactive
+    session token must never ride the -H env forwarding."""
+    plans = launcher.build_multihost_plan(
+        [("h1", 1)], ["true"], cwd="/",
+        base_env={"BLUEFOG_SESSION_TOKEN": "s3cret",
+                  "BLUEFOG_LOG_LEVEL": "debug"})
+    remote = plans[0][2][-1]
+    assert "s3cret" not in remote and "BLUEFOG_SESSION_TOKEN" not in remote
+    assert "BLUEFOG_LOG_LEVEL=debug" in remote

@@ -40,6 +40,7 @@ MODULES = [
     ("bluefog_tpu.run.interactive", "Interactive multi-host mode"),
     ("bluefog_tpu.utils.utility", "Broadcast utilities (restart flow)"),
     ("bluefog_tpu.utils.torch_compat", "PyTorch migration helpers"),
+    ("bluefog_tpu.utils.tf_compat", "TensorFlow/Keras migration helpers"),
     ("bluefog_tpu.utils.config", "Environment configuration"),
     ("bluefog_tpu.utils.timeline", "Timeline tracing"),
     ("bluefog_tpu.utils.watchdog", "Stall watchdog"),

@@ -141,6 +141,12 @@ def run_bench(on_accelerator: bool, probe_info: dict) -> dict:
 
     if not on_accelerator:
         jax.config.update("jax_platforms", "cpu")
+    else:
+        # persistent compilation cache: re-runs of the hardware battery
+        # (validate/calibrate/sweep after this) skip the 20-40 s first
+        # compiles, so every tunnel-hour buys more measurements
+        from bluefog_tpu.utils.config import enable_compilation_cache
+        enable_compilation_cache()
 
     import jax.numpy as jnp
 

@@ -63,6 +63,35 @@ def apply_recommended_xla_flags() -> bool:
     return True
 
 
+def enable_compilation_cache(path: Optional[str] = None) -> Optional[str]:
+    """Enable JAX's persistent compilation cache (idempotent).
+
+    First TPU compiles cost 20-40 s; the cache makes every repeat program
+    (re-runs of the bench/validate/calibrate battery, resumed training)
+    load in milliseconds.  Default location is ``BLUEFOG_COMPILE_CACHE``
+    (set to ``0``/``off`` to disable) or ``~/.cache/bluefog_tpu_xla``.
+    Returns the cache dir, or None when disabled/unavailable.
+    """
+    env = os.environ.get("BLUEFOG_COMPILE_CACHE", "")
+    if env.lower() in ("0", "off", "false", "none", "no", "disable"):
+        return None
+    path = path or env or os.path.join(
+        os.path.expanduser("~"), ".cache", "bluefog_tpu_xla")
+    try:
+        import jax
+
+        os.makedirs(path, exist_ok=True)
+        # cache everything that took a meaningful compile (the default 1 s
+        # floor would skip small collective programs that still cost real
+        # dispatch-path latency to rebuild).  The dir is set LAST so a
+        # partial failure cannot leave caching active while we report None.
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)
+        jax.config.update("jax_compilation_cache_dir", path)
+        return path
+    except Exception:                      # old jax / read-only filesystem
+        return None
+
+
 def looks_like_tpu_environment(env=None) -> bool:
     """Heuristic: will this process (or its children) parse TPU XLA flags?
 

@@ -168,3 +168,27 @@ def test_multihost_plan_never_embeds_session_token(monkeypatch):
     remote = plans[0][2][-1]
     assert "s3cret" not in remote and "BLUEFOG_SESSION_TOKEN" not in remote
     assert "BLUEFOG_LOG_LEVEL=debug" in remote
+
+
+def test_enable_compilation_cache(tmp_path, monkeypatch):
+    import jax
+
+    from bluefog_tpu.utils.config import enable_compilation_cache
+
+    old_dir = jax.config.jax_compilation_cache_dir
+    old_floor = jax.config.jax_persistent_cache_min_compile_time_secs
+    try:
+        for off in ("off", "no", "0"):
+            monkeypatch.setenv("BLUEFOG_COMPILE_CACHE", off)
+            assert enable_compilation_cache() is None
+        cache = tmp_path / "xla_cache"
+        monkeypatch.setenv("BLUEFOG_COMPILE_CACHE", str(cache))
+        assert enable_compilation_cache() == str(cache)
+        assert cache.is_dir()
+        assert jax.config.jax_compilation_cache_dir == str(cache)
+    finally:
+        # global jax config: restore so later tests in this process don't
+        # silently persist their compiles into the pytest tmp dir
+        jax.config.update("jax_compilation_cache_dir", old_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          old_floor)

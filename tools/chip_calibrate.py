@@ -30,6 +30,9 @@ from jax import lax
 
 sys.path.insert(0, ".")
 from bluefog_tpu.api import hard_sync  # noqa: E402
+from bluefog_tpu.utils.config import enable_compilation_cache  # noqa: E402
+
+enable_compilation_cache()
 
 
 def _timed(f, x):

@@ -35,6 +35,8 @@ def main():
 
     import jax
 
+    from bluefog_tpu.utils.config import enable_compilation_cache
+    enable_compilation_cache()
     if args.allow_cpu:
         # the axon plugin force-sets jax_platforms at boot; without this a
         # CPU smoke dials the TPU tunnel

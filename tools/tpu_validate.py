@@ -21,6 +21,9 @@ import numpy as np
 sys.path.insert(0, ".")
 from bluefog_tpu.api import hard_sync  # noqa: E402
 from bluefog_tpu.ops import pallas_attention as pa  # noqa: E402
+from bluefog_tpu.utils.config import enable_compilation_cache  # noqa: E402
+
+enable_compilation_cache()
 
 RESULTS = []
 

@@ -72,7 +72,7 @@ def enable_compilation_cache(path: Optional[str] = None) -> Optional[str]:
     (set to ``0``/``off`` to disable) or ``~/.cache/bluefog_tpu_xla``.
     Returns the cache dir, or None when disabled/unavailable.
     """
-    env = os.environ.get("BLUEFOG_COMPILE_CACHE", "")
+    env = os.environ.get("BLUEFOG_COMPILE_CACHE", "").strip()
     if env.lower() in ("0", "off", "false", "none", "no", "disable"):
         return None
     path = path or env or os.path.join(

@@ -319,3 +319,75 @@ def test_pipeline_by_ring_sp_grads_match_oracle(cpu_devices):
     for key in ("wqkv", "wo"):
         np.testing.assert_allclose(np.asarray(g[key]), np.asarray(go[key]),
                                    rtol=1e-4, atol=1e-6, err_msg=key)
+
+
+def test_dp_pp_tp_three_axis_composition(cpu_devices):
+    """The full 3-D layout on one mesh (dp=2, stage=2, tp=2): Megatron
+    column/row-split MLP blocks inside each pipeline stage, activations
+    ppermute along stage, tensor psum along tp, gradients averaged along
+    dp.  Forward pinned to the dense oracle; two training steps reduce
+    the loss with the dp pair staying bitwise in lock-step."""
+    DP, ST, TP = 2, 2, 2
+    Dd, Hh = 4, 8
+    Mm, Bb = 2, 2
+    rng = np.random.default_rng(5)
+    mesh = Mesh(np.array(cpu_devices[:8]).reshape(DP, ST, TP),
+                ("dp", "stage", "tp"))
+
+    # global param arrays [dp, stage, tp, ...]; identical across dp
+    w1 = rng.normal(size=(ST, TP, Dd, Hh // TP)).astype(np.float32) * 0.4
+    w2 = rng.normal(size=(ST, TP, Hh // TP, Dd)).astype(np.float32) * 0.4
+    params = {"w1": jnp.asarray(np.broadcast_to(w1, (DP,) + w1.shape)),
+              "w2": jnp.asarray(np.broadcast_to(w2, (DP,) + w2.shape))}
+    # per-dp data shards (different), replicated over stage/tp
+    data = rng.normal(size=(DP, Mm, Bb, Dd)).astype(np.float32)
+
+    def stage_fn(p, x):
+        # Megatron block: column-split W1, row-split W2, one psum over tp
+        h = jnp.tanh(x @ p["w1"])
+        return x + jax.lax.psum(h @ p["w2"], "tp")
+
+    def train_step(p, mbs):
+        # block views: p leaves [1,1,1,...] (dp,stage,tp), mbs [1,Mm,Bb,Dd]
+        q = jax.tree.map(lambda t: t[0, 0, 0], p)
+        mb = mbs[0]
+
+        def loss_fn(q_):
+            out = pipeline_apply(stage_fn, q_, mb, axis="stage")
+            out = last_stage_value(out, axis="stage")
+            return jnp.mean((out - 1.0) ** 2)
+
+        loss, g = jax.value_and_grad(loss_fn)(q)
+        g = jax.tree.map(lambda t: jax.lax.pmean(t, "dp"), g)
+        new = jax.tree.map(lambda a, b: a - 0.2 * b, q, g)
+        return (jax.tree.map(lambda t: t[None, None, None], new),
+                loss[None, None, None])
+
+    fn = jax.jit(jax.shard_map(
+        train_step, mesh=mesh,
+        in_specs=(P("dp", "stage", "tp"), P("dp", None, None, None)),
+        out_specs=(P("dp", "stage", "tp"), P("dp", "stage", "tp")),
+        check_vma=False))
+
+    # oracle forward for the initial params on dp0's data
+    def oracle(x):
+        for s in range(ST):
+            W1 = np.concatenate([w1[s, t] for t in range(TP)], axis=1)
+            W2 = np.concatenate([w2[s, t] for t in range(TP)], axis=0)
+            x = x + np.tanh(x @ W1) @ W2
+        return x
+
+    p, losses = params, []
+    for _ in range(3):
+        p, loss = fn(p, jnp.asarray(data))
+        loss = np.asarray(loss)
+        losses.append(float(loss.mean()))
+    # dp pair stays in lock-step (grads pmean'd from identical init)
+    np.testing.assert_array_equal(np.asarray(p["w1"])[0],
+                                  np.asarray(p["w1"])[1])
+    # loss decreased
+    assert losses[-1] < losses[0], losses
+    # first-step loss matches the dense oracle's loss per dp shard
+    exp0 = np.mean([(oracle(data[d]) - 1.0) ** 2 for d in range(DP)])
+    got0 = losses[0]
+    np.testing.assert_allclose(got0, exp0, rtol=1e-5)

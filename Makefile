@@ -61,6 +61,7 @@ test_examples:
 	$(PY) examples/pipeline_lm.py --virtual-cpu --steps 30 --interleaved 2 \
 		--micro 4
 	$(PY) examples/pipeline_lm.py --virtual-cpu --steps 30 --hetero
+	$(PY) examples/llm_3d.py --virtual-cpu --steps 40
 
 # build the native (C++) components explicitly (otherwise built lazily)
 native:

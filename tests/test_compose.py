@@ -325,8 +325,18 @@ def test_dp_pp_tp_three_axis_composition(cpu_devices):
     """The full 3-D layout on one mesh (dp=2, stage=2, tp=2): Megatron
     column/row-split MLP blocks inside each pipeline stage, activations
     ppermute along stage, tensor psum along tp, gradients averaged along
-    dp.  Forward pinned to the dense oracle; two training steps reduce
-    the loss with the dp pair staying bitwise in lock-step."""
+    dp.  Forward loss AND first-step gradients pinned to the dense
+    oracle; training reduces the loss with the dp pair in lock-step.
+
+    Gradient recipe (cf. the ring-SP test above): the differentiated
+    scalar contains NO loss-side collective — the raw pipeline output is
+    masked to the last stage and the seed is scaled 1/TP, because the TP
+    ranks hold identical replicas of the output (each would seed the
+    full cotangent) while the *structural* row-parallel psum inside the
+    block transposes as a cotangent sum over tp (check_vma=False).
+    Masking + 1/TP makes every cotangent seed exactly once — measured
+    1.0x the dense-oracle gradient (unmasked last_stage_value gives
+    S*TP = 4x)."""
     DP, ST, TP = 2, 2, 2
     Dd, Hh = 4, 8
     Mm, Bb = 2, 2
@@ -351,36 +361,45 @@ def test_dp_pp_tp_three_axis_composition(cpu_devices):
         # block views: p leaves [1,1,1,...] (dp,stage,tp), mbs [1,Mm,Bb,Dd]
         q = jax.tree.map(lambda t: t[0, 0, 0], p)
         mb = mbs[0]
+        sid = jax.lax.axis_index("stage")
 
         def loss_fn(q_):
             out = pipeline_apply(stage_fn, q_, mb, axis="stage")
-            out = last_stage_value(out, axis="stage")
-            return jnp.mean((out - 1.0) ** 2)
+            # off-last-stage outputs are zeros -> mask their garbage error;
+            # 1/TP seeds the replicated output's cotangent once (docstring)
+            err = jnp.mean((out - 1.0) ** 2)
+            return jnp.where(sid == ST - 1, err, 0.0) / TP
 
         loss, g = jax.value_and_grad(loss_fn)(q)
+        # outside AD: true loss (replicate it), dp-average the grads
+        loss = jax.lax.psum(loss, ("stage", "tp"))
         g = jax.tree.map(lambda t: jax.lax.pmean(t, "dp"), g)
         new = jax.tree.map(lambda a, b: a - 0.2 * b, q, g)
         return (jax.tree.map(lambda t: t[None, None, None], new),
-                loss[None, None, None])
+                loss[None, None, None], jax.tree.map(
+                    lambda t: t[None, None, None], g))
 
     fn = jax.jit(jax.shard_map(
         train_step, mesh=mesh,
         in_specs=(P("dp", "stage", "tp"), P("dp", None, None, None)),
-        out_specs=(P("dp", "stage", "tp"), P("dp", "stage", "tp")),
+        out_specs=(P("dp", "stage", "tp"), P("dp", "stage", "tp"),
+                   P("dp", "stage", "tp")),
         check_vma=False))
 
-    # oracle forward for the initial params on dp0's data
-    def oracle(x):
+    # dense oracle (jax so we can take its gradient too)
+    def oracle_loss(wpair, x):
+        w1f, w2f = wpair
         for s in range(ST):
-            W1 = np.concatenate([w1[s, t] for t in range(TP)], axis=1)
-            W2 = np.concatenate([w2[s, t] for t in range(TP)], axis=0)
-            x = x + np.tanh(x @ W1) @ W2
-        return x
+            W1 = jnp.concatenate([w1f[s, t] for t in range(TP)], axis=1)
+            W2 = jnp.concatenate([w2f[s, t] for t in range(TP)], axis=0)
+            x = x + jnp.tanh(x @ W1) @ W2
+        return jnp.mean((x - 1.0) ** 2)
 
-    p, losses = params, []
+    p, losses, g0 = params, [], None
     for _ in range(3):
-        p, loss = fn(p, jnp.asarray(data))
+        p, loss, g = fn(p, jnp.asarray(data))
         loss = np.asarray(loss)
+        g0 = g if g0 is None else g0
         losses.append(float(loss.mean()))
     # dp pair stays in lock-step (grads pmean'd from identical init)
     np.testing.assert_array_equal(np.asarray(p["w1"])[0],
@@ -388,6 +407,17 @@ def test_dp_pp_tp_three_axis_composition(cpu_devices):
     # loss decreased
     assert losses[-1] < losses[0], losses
     # first-step loss matches the dense oracle's loss per dp shard
-    exp0 = np.mean([(oracle(data[d]) - 1.0) ** 2 for d in range(DP)])
-    got0 = losses[0]
-    np.testing.assert_allclose(got0, exp0, rtol=1e-5)
+    exp0 = np.mean([float(oracle_loss((jnp.asarray(w1), jnp.asarray(w2)),
+                                      jnp.asarray(data[d])))
+                    for d in range(DP)])
+    np.testing.assert_allclose(losses[0], exp0, rtol=1e-5)
+    # first-step GRADIENTS match the dense oracle (dp-averaged): the 3-D
+    # backward — pipeline transpose x structural tp psum x dp pmean — is
+    # exactly the dense gradient, not a multiple of it
+    go = [jax.grad(oracle_loss)((jnp.asarray(w1), jnp.asarray(w2)),
+                                jnp.asarray(data[d])) for d in range(DP)]
+    go_avg = jax.tree.map(lambda a, b: (a + b) / 2, go[0], go[1])
+    for key, exp in (("w1", go_avg[0]), ("w2", go_avg[1])):
+        np.testing.assert_allclose(
+            np.asarray(g0[key])[0], np.asarray(exp), rtol=2e-4,
+            atol=1e-6, err_msg=key)

@@ -47,12 +47,23 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-np", "--num-local-processes", type=int, default=None,
                    help="spawn N local processes with a virtual device split "
                         "(testing/CPU; reference: bfrun -np)")
-    p.add_argument("-H", "--hosts", default=None,
-                   help="comma-separated remote hosts, each optionally "
-                        "host:slots (processes on that host, default 1): "
-                        "one SSH fan-out starts every rank with the "
-                        "jax.distributed bootstrap env (reference: bfrun "
-                        "-H + mpirun's remote spawn, run.py:133-198)")
+    p.add_argument("-v", "--version", action="store_true",
+                   help="print the bluefog_tpu version and exit "
+                        "(reference: bfrun -v)")
+    hosts_group = p.add_mutually_exclusive_group()
+    hosts_group.add_argument(
+        "-H", "--hosts", default=None,
+        help="comma-separated remote hosts, each optionally "
+             "host:slots (processes on that host, default 1): "
+             "one SSH fan-out starts every rank with the "
+             "jax.distributed bootstrap env (reference: bfrun "
+             "-H + mpirun's remote spawn, run.py:133-198)")
+    hosts_group.add_argument(
+        "--hostfile", default=None,
+        help="file of hosts, one '<hostname> slots=<n>' per "
+             "line (reference: bfrun -hostfile); alternative to -H")
+    p.add_argument("--verbose", action="store_true",
+                   help="print the per-rank launch plan before starting")
     p.add_argument("--ssh-port", type=int, default=None,
                    help="SSH port for -H fan-out")
     p.add_argument("--remote-shell", default="ssh",
@@ -134,6 +145,35 @@ def parse_hosts(spec: str):
     return out
 
 
+def parse_hostfile(path: str):
+    """mpirun-style hostfile: one ``<hostname> slots=<n>`` per line
+    (reference: ``bfrun -hostfile``, ``run.py:84-87``); ``slots`` defaults
+    to 1, ``#`` comments and blank lines are skipped."""
+    out = []
+    with open(path) as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.split("#", 1)[0].strip()
+            if not line:
+                continue
+            fields = line.split()
+            slots = 1
+            for field in fields[1:]:
+                key, _, val = field.partition("=")
+                if key != "slots":
+                    raise SystemExit(
+                        f"{path}:{lineno}: unsupported hostfile field "
+                        f"{field!r} (expected '<hostname> slots=<n>')")
+                if not val.isdigit() or int(val) < 1:
+                    raise SystemExit(
+                        f"{path}:{lineno}: slots must be a positive "
+                        f"integer, got {val!r}")
+                slots = int(val)
+            out.append((fields[0], slots))
+    if not out:
+        raise SystemExit(f"hostfile {path} lists no hosts")
+    return out
+
+
 # env the remote ranks need even without explicit -x (reference: bfrun
 # forwards every exportable variable through mpirun -x; here the relevant
 # namespaces are forwarded and -x adds the rest)
@@ -194,7 +234,8 @@ def _multihost_fanout(args, env) -> int:
     """``bfrun-tpu -H host1,host2 python train.py``: start every rank over
     SSH, stream their output, propagate the first failure — the one-command
     multi-host launch the reference gets from mpirun's remote spawn."""
-    hosts = parse_hosts(args.hosts)
+    hosts = (parse_hostfile(args.hostfile) if args.hostfile
+             else parse_hosts(args.hosts))
     plans = build_multihost_plan(
         hosts, args.command, cwd=os.getcwd(),
         coordinator=args.coordinator, base_env=env, extra_env=args.env,
@@ -202,6 +243,8 @@ def _multihost_fanout(args, env) -> int:
     procs = []
     for host, pid, argv in plans:
         print(f"bfrun-tpu: starting rank {pid} on {host}", flush=True)
+        if args.verbose:
+            print(f"bfrun-tpu:   {shlex.join(argv)}", flush=True)
         procs.append(subprocess.Popen(argv))
     # first failure kills the survivors (mpirun semantics): a dead rank
     # leaves the others blocked in jax.distributed collectives forever
@@ -302,6 +345,10 @@ def _apply_coordinator_env(args, env) -> None:
 
 def main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
+    if args.version:
+        from .. import __version__
+        print(f"bluefog_tpu {__version__}")
+        return 0
     if args.interactive_worker:
         if not args.controller:
             raise SystemExit("--interactive-worker requires --controller")
@@ -337,7 +384,7 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     env = _child_env(args)
 
-    if args.hosts:
+    if args.hosts or args.hostfile:
         args.command = cmd
         return _multihost_fanout(args, env)
 

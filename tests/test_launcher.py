@@ -192,3 +192,51 @@ def test_enable_compilation_cache(tmp_path, monkeypatch):
         jax.config.update("jax_compilation_cache_dir", old_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs",
                           old_floor)
+
+
+def test_version_flag(capsys):
+    assert launcher.main(["--version"]) == 0
+    assert "bluefog_tpu 0." in capsys.readouterr().out
+
+
+def test_parse_hostfile(tmp_path):
+    hf = tmp_path / "hosts"
+    hf.write_text("# cluster\nh1 slots=4\n\nh2   # default one slot\n"
+                  "h3 slots=2\n")
+    assert launcher.parse_hostfile(str(hf)) == [
+        ("h1", 4), ("h2", 1), ("h3", 2)]
+    hf.write_text("h1 gpus=4\n")
+    with pytest.raises(SystemExit, match="unsupported hostfile field"):
+        launcher.parse_hostfile(str(hf))
+    hf.write_text("# nothing\n")
+    with pytest.raises(SystemExit, match="no hosts"):
+        launcher.parse_hostfile(str(hf))
+    # bad slots values fail with the file:line diagnostic, never launch 0
+    for bad in ("h1 slots=abc", "h1 slots=0", "h1 slots=-2"):
+        hf.write_text(bad + "\n")
+        with pytest.raises(SystemExit, match="positive integer"):
+            launcher.parse_hostfile(str(hf))
+
+
+def test_hostfile_fanout_e2e(tmp_path):
+    """--hostfile drives the same fan-out as -H, slots expanded per host."""
+    import sys
+    stub = tmp_path / "fake_ssh"
+    stub.write_text('#!/bin/sh\nshift\nexec sh -c "$@"\n')
+    stub.chmod(0o755)
+    hf = tmp_path / "hosts"
+    hf.write_text("hA slots=2\nhB slots=1\n")
+    out = tmp_path / "r"
+    code = launcher.main(
+        ["--hostfile", str(hf), "--remote-shell", str(stub), "--verbose",
+         "--", sys.executable, "-c",
+         "import os,pathlib; pathlib.Path("
+         f"'{out}' + os.environ['BLUEFOG_PROCESS_ID']).write_text("
+         "os.environ['BLUEFOG_NUM_PROCESSES'])"])
+    assert code == 0
+    for i in range(3):
+        assert (out.parent / f"r{i}").read_text() == "3"
+    # argparse-level mutual exclusion: rejected on EVERY path, even
+    # without a command
+    with pytest.raises(SystemExit):
+        launcher.main(["-H", "x", "--hostfile", str(hf)])

@@ -67,6 +67,24 @@ def _wire_decode(wire: str, parts: Tuple[jax.Array, ...], dtype) -> jax.Array:
     return (q.astype(jnp.float32) * scale).astype(dtype)
 
 
+def _wire_ppermute(wire: Optional[str], send: jax.Array, axis: Axis,
+                   perm) -> jax.Array:
+    """One ppermute round, optionally wire-compressed.
+
+    The barriers pin the codec around the permute: XLA's collective
+    reorderer happily commutes a bare convert across a collective-permute
+    and fuses encode+decode into a no-op, which silently puts FULL-WIDTH
+    bytes back on the wire (caught by the v5e AOT payload tests).  Shared
+    by the gossip collectives and the window ops so the pinning subtlety
+    lives in exactly one place."""
+    if wire is None:
+        return lax.ppermute(send, axis, perm=perm)
+    parts = lax.optimization_barrier(_wire_encode(wire, send))
+    moved = lax.optimization_barrier(tuple(
+        lax.ppermute(p, axis, perm=perm) for p in parts))
+    return _wire_decode(wire, moved, send.dtype)
+
+
 def neighbor_allreduce(
     x: jax.Array,
     sched: CommSchedule,
@@ -100,18 +118,7 @@ def neighbor_allreduce(
             # dst-weighting: the *sender* scales per-edge before the permute
             # (reference fusion-buffer trick, mpi_controller.cc:1394-1454).
             send = x * _table(sched.send_scale[r], idx, x.dtype)
-        if wire is None:
-            recv = lax.ppermute(send, axis, perm=sched.rounds[r])
-        else:
-            # barriers pin the codec around the permute: XLA's collective
-            # reorderer happily commutes a bare convert across a
-            # collective-permute and fuses encode+decode into a no-op,
-            # which silently puts FULL-WIDTH bytes back on the wire
-            parts = lax.optimization_barrier(_wire_encode(wire, send))
-            moved = lax.optimization_barrier(tuple(
-                lax.ppermute(p, axis, perm=sched.rounds[r])
-                for p in parts))
-            recv = _wire_decode(wire, moved, x.dtype)
+        recv = _wire_ppermute(wire, send, axis, sched.rounds[r])
         acc = acc + recv * _table(sched.recv_weight[r], idx, x.dtype)
     return acc
 

@@ -32,6 +32,7 @@ import numpy as np
 from jax import lax
 
 from ..schedule import CommSchedule
+from .collectives import _wire_ppermute
 
 Axis = str
 
@@ -56,15 +57,19 @@ def win_create(x: jax.Array, sched: CommSchedule, *, zero_init: bool = True) -> 
 
 
 def _deliver(win: Window, x: jax.Array, sched: CommSchedule, axis: Axis,
-             accumulate: bool, apply_dst_scale: bool = True) -> Window:
+             accumulate: bool, apply_dst_scale: bool = True,
+             wire: Optional[str] = None) -> Window:
     """Send ``x`` along every out-edge; land in receivers' slot mailboxes."""
     idx = lax.axis_index(axis)
+    if wire is not None and not jnp.issubdtype(x.dtype, jnp.floating):
+        raise ValueError(
+            f"wire compression needs a real float input, got {x.dtype}")
     recv = win.recv
     for r in range(sched.num_rounds):
         send = x
         if apply_dst_scale and sched.uses_dst_weighting:
             send = x * jnp.asarray(sched.send_scale[r])[idx].astype(x.dtype)
-        incoming = lax.ppermute(send, axis, perm=sched.rounds[r])
+        incoming = _wire_ppermute(wire, send, axis, sched.rounds[r])
         received = jnp.asarray(sched.recv_src[r] >= 0)[idx]
         slot = jnp.asarray(sched.recv_slot[r])[idx]
         if accumulate:
@@ -75,20 +80,25 @@ def _deliver(win: Window, x: jax.Array, sched: CommSchedule, axis: Axis,
 
 
 def win_put(win: Window, x: jax.Array, sched: CommSchedule, *,
-            axis: Axis = "rank") -> Window:
+            axis: Axis = "rank", wire: Optional[str] = None) -> Window:
     """Overwrite out-neighbors' mailboxes with ``x`` (reference: WinPut,
-    ``mpi_controller.cc:952-1032``).  dst-weighting scales per edge."""
-    return _deliver(win, x, sched, axis, accumulate=False)
+    ``mpi_controller.cc:952-1032``).  dst-weighting scales per edge.
+    ``wire`` compresses the permuted bytes (``"bf16"``/``"int8"``, as in
+    :func:`bluefog_tpu.ops.neighbor_allreduce`) — async gossip is the
+    comm-bound regime the codecs exist for."""
+    return _deliver(win, x, sched, axis, accumulate=False, wire=wire)
 
 
 def win_accumulate(win: Window, x: jax.Array, sched: CommSchedule, *,
-                   axis: Axis = "rank") -> Window:
+                   axis: Axis = "rank",
+                   wire: Optional[str] = None) -> Window:
     """Add ``x`` into out-neighbors' mailboxes (reference: WinAccumulate,
     ``mpi_controller.cc:1035-1120``)."""
-    return _deliver(win, x, sched, axis, accumulate=True)
+    return _deliver(win, x, sched, axis, accumulate=True, wire=wire)
 
 
-def win_get(win: Window, sched: CommSchedule, *, axis: Axis = "rank") -> Window:
+def win_get(win: Window, sched: CommSchedule, *, axis: Axis = "rank",
+            wire: Optional[str] = None) -> Window:
     """Fetch in-neighbors' window tensors into this rank's mailboxes
     (reference: WinGet, ``mpi_controller.cc:1122-1183``).
 
@@ -97,7 +107,7 @@ def win_get(win: Window, sched: CommSchedule, *, axis: Axis = "rank") -> Window:
     a get fetches the raw window tensor.
     """
     return _deliver(win, win.value, sched, axis, accumulate=False,
-                    apply_dst_scale=False)
+                    apply_dst_scale=False, wire=wire)
 
 
 def win_update(

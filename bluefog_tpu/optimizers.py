@@ -360,6 +360,7 @@ def win_put_optimizer(
     axis: Axis = "rank",
     num_steps_per_communication: int = 1,
     fuse: bool = True,
+    wire: Optional[str] = None,
 ) -> DecentralizedOptimizer:
     """Mailbox gossip: put params to out-neighbors, combine mailboxes, adapt.
 
@@ -373,10 +374,11 @@ def win_put_optimizer(
     """
     def leaf(s, w, x, ax):
         # combine last step's mailboxes with the current value, then put
-        # the combined value to out-neighbors
+        # the combined value to out-neighbors (wire= compresses the put
+        # bytes; the local combine stays full precision)
         w = wops.Window(value=x, recv=w.recv)
         value, w = wops.win_update(w, s, axis=ax)
-        return wops.win_put(w, value, s, axis=ax)
+        return wops.win_put(w, value, s, axis=ax, wire=wire)
 
     return _mailbox_optimizer(
         opt, sched, leaf, axis=axis,
@@ -391,6 +393,7 @@ def pull_get_optimizer(
     axis: Axis = "rank",
     num_steps_per_communication: int = 1,
     fuse: bool = True,
+    wire: Optional[str] = None,
 ) -> DecentralizedOptimizer:
     """Pull-based gossip: fetch neighbors' CURRENT params, combine, adapt.
 
@@ -408,7 +411,7 @@ def pull_get_optimizer(
     def leaf(s, w, x, ax):
         # publish the current value, pull in-neighbors' current values
         # into the mailboxes, combine fresh
-        w = wops.win_get(w, s, axis=ax)
+        w = wops.win_get(w, s, axis=ax, wire=wire)
         _, w = wops.win_update(w, s, axis=ax)
         return w
 

@@ -195,7 +195,8 @@ def _delivered_mask(sched: CommSchedule, slots: int) -> np.ndarray:
     return mask
 
 
-def _move(kind: str, tensor_or_none, name: str, dst_weights) -> None:
+def _move(kind: str, tensor_or_none, name: str, dst_weights,
+          wire=None) -> None:
     ctx = _mesh.get_context()
     entry = _entry(name)
     sched = (_dst_schedule(entry.sched, dst_weights)
@@ -209,19 +210,22 @@ def _move(kind: str, tensor_or_none, name: str, dst_weights) -> None:
     if kind == "get":
         fn = _cached(
             ("get", sched, ctx.mesh, entry.window.value.shape,
-             entry.window.value.dtype.name),
+             entry.window.value.dtype.name, wire),
             lambda: _sm(
                 lambda w: jax.tree.map(lambda v: v[None], wops.win_get(
-                    jax.tree.map(lambda v: v[0], w), sched, axis="rank")),
+                    jax.tree.map(lambda v: v[0], w), sched, axis="rank",
+                    wire=wire)),
                 ctx.mesh, (_win_specs(),), _win_specs()))
         entry.window = fn(entry.window)
     else:
         _mesh_check(tensor_or_none, ctx.size)
         fn = _cached(
-            (kind, sched, ctx.mesh, tensor_or_none.shape, tensor_or_none.dtype.name),
+            (kind, sched, ctx.mesh, tensor_or_none.shape,
+             tensor_or_none.dtype.name, wire),
             lambda: _sm(
                 lambda w, x: jax.tree.map(lambda v: v[None], op(
-                    jax.tree.map(lambda v: v[0], w), x[0], sched, axis="rank")),
+                    jax.tree.map(lambda v: v[0], w), x[0], sched, axis="rank",
+                    wire=wire)),
                 ctx.mesh, (_win_specs(), P("rank")), _win_specs()))
         entry.window = fn(entry.window, tensor_or_none)
     if _assoc_p_enabled and kind in ("put", "acc"):
@@ -245,24 +249,28 @@ def _mesh_check(x, n):
 
 
 def win_put(tensor: jax.Array, name: str, *,
-            dst_weights=None, require_mutex: bool = False) -> None:
+            dst_weights=None, require_mutex: bool = False,
+            wire: Optional[str] = None) -> None:
     """Deliver ``tensor`` into out-neighbors' mailboxes (reference:
     ``bf.win_put``).  ``require_mutex`` is accepted for parity; see module
-    docstring."""
-    _move("put", tensor, name, dst_weights)
+    docstring.  ``wire`` compresses the permuted bytes
+    (``"bf16"``/``"int8"``) — the async-gossip counterpart of
+    ``neighbor_allreduce``'s wire codecs."""
+    _move("put", tensor, name, dst_weights, wire=wire)
 
 
 def win_accumulate(tensor: jax.Array, name: str, *,
-                   dst_weights=None, require_mutex: bool = False) -> None:
+                   dst_weights=None, require_mutex: bool = False,
+                   wire: Optional[str] = None) -> None:
     """Add ``tensor`` into out-neighbors' mailboxes (reference:
     ``bf.win_accumulate``)."""
-    _move("acc", tensor, name, dst_weights)
+    _move("acc", tensor, name, dst_weights, wire=wire)
 
 
-def win_get(name: str) -> None:
+def win_get(name: str, *, wire: Optional[str] = None) -> None:
     """Fetch in-neighbors' window tensors into this window's mailboxes
     (reference: ``bf.win_get``)."""
-    _move("get", None, name, None)
+    _move("get", None, name, None, wire=wire)
 
 
 # ---------------------------------------------------------------------------

@@ -63,7 +63,8 @@ def build_parser() -> argparse.ArgumentParser:
         help="file of hosts, one '<hostname> slots=<n>' per "
              "line (reference: bfrun -hostfile); alternative to -H")
     p.add_argument("--verbose", action="store_true",
-                   help="print the per-rank launch plan before starting")
+                   help="with -H/--hostfile: print each rank's remote "
+                        "command line before starting it")
     p.add_argument("--ssh-port", type=int, default=None,
                    help="SSH port for -H fan-out")
     p.add_argument("--remote-shell", default="ssh",

@@ -144,11 +144,10 @@ def main():
 
     name = args.dist_optimizer
     if args.wire and name in ("gradient_allreduce", "zero_allreduce",
-                              "win_put", "pull_get",
                               "push_sum", "allreduce", "empty"):
         raise SystemExit(
-            f"--wire applies to the neighbor/hierarchical gossip "
-            f"strategies, not {name}")
+            f"--wire applies to the gossip strategies (neighbor/"
+            f"hierarchical/win_put/pull_get/choco), not {name}")
     if name == "gradient_allreduce":
         strategy = bfopt.gradient_allreduce(opt)
     elif name == "zero_allreduce":
@@ -158,9 +157,11 @@ def main():
         # error-compensated compressed gossip (defaults to int8 wire)
         strategy = bfopt.choco_gossip(opt, wire=args.wire or "int8")
     elif name == "win_put":
-        strategy = bfopt.DistributedWinPutOptimizer(opt)
+        strategy = bfopt.DistributedWinPutOptimizer(
+            opt, **({"wire": args.wire} if args.wire else {}))
     elif name == "pull_get":
-        strategy = bfopt.DistributedPullGetOptimizer(opt)
+        strategy = bfopt.DistributedPullGetOptimizer(
+            opt, **({"wire": args.wire} if args.wire else {}))
     elif name == "push_sum":
         strategy = bfopt.DistributedPushSumOptimizer(opt)
     else:

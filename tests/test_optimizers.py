@@ -137,6 +137,15 @@ def test_pull_get_optimizer_converges():
     _check(w, w_opt)
 
 
+def test_win_put_optimizer_bf16_wire_converges():
+    """Mailbox gossip with compressed puts: the bounded quantization error
+    perturbs but does not break consensus+optimization (the async-gossip
+    counterpart of the CTA wire test)."""
+    strat = bfopt.win_put_optimizer(optax.sgd(0.05), wire="bf16")
+    w, w_opt = _run(strat)
+    _check(w, w_opt, atol=0.2)
+
+
 def _trajectory(strategy, steps=6, seed=0):
     """Per-step parameter snapshots (steps_per_call=1 so staleness shows)."""
     A, b, _ = _problem(seed)

@@ -201,3 +201,44 @@ def test_push_sum_weight_conservation():
 
     ratio = np.asarray(x)[:, :DIM] / np.asarray(x)[:, DIM:]
     np.testing.assert_allclose(ratio, np.tile(global_mean, (N, 1)), atol=1e-3)
+
+
+def test_win_put_wire_codecs(cpu_devices):
+    """win_put with wire compression: bf16 matches the uncompressed put to
+    cast tolerance; int8 to quantization tolerance; int dtypes reject."""
+    import jax
+    import pytest
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    import bluefog_tpu.topology as tu
+    from bluefog_tpu import schedule as sch
+    from bluefog_tpu.ops import windows as wops
+
+    n = 8
+    sched = sch.compile_topology(tu.ExponentialTwoGraph(n))
+    mesh = Mesh(np.array(cpu_devices[:n]), ("rank",))
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.normal(size=(n, 16)), jnp.float32)
+
+    def run(wire):
+        def f(xb):
+            w = wops.win_create(xb[0], sched)
+            w = wops.win_put(w, xb[0], sched, axis="rank", wire=wire)
+            return w.recv[None]
+        fn = jax.jit(jax.shard_map(
+            f, mesh=mesh, in_specs=P("rank"), out_specs=P("rank")))
+        return np.asarray(fn(x))
+
+    exact = run(None)
+    np.testing.assert_allclose(run("bf16"), exact, rtol=1e-2, atol=1e-2)
+    np.testing.assert_allclose(run("int8"), exact, rtol=0.1, atol=0.05)
+    assert not np.array_equal(run("bf16"), exact)   # it really quantized
+
+    with pytest.raises(ValueError, match="real float"):
+        def fi(xb):
+            w = wops.win_create(xb[0], sched)
+            return wops.win_put(w, xb[0], sched, axis="rank",
+                                wire="bf16").recv[None]
+        jax.jit(jax.shard_map(
+            fi, mesh=mesh, in_specs=P("rank"), out_specs=P("rank")))(
+            jnp.ones((n, 4), jnp.int32))

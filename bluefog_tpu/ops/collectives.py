@@ -79,6 +79,10 @@ def _wire_ppermute(wire: Optional[str], send: jax.Array, axis: Axis,
     lives in exactly one place."""
     if wire is None:
         return lax.ppermute(send, axis, perm=perm)
+    if not jnp.issubdtype(send.dtype, jnp.floating):
+        # complex would silently lose its imaginary part in the codecs
+        raise ValueError(
+            f"wire compression needs a real float input, got {send.dtype}")
     parts = lax.optimization_barrier(_wire_encode(wire, send))
     moved = lax.optimization_barrier(tuple(
         lax.ppermute(p, axis, perm=perm) for p in parts))
@@ -107,10 +111,6 @@ def neighbor_allreduce(
     consensus tolerates stale neighbor values.
     """
     idx = lax.axis_index(axis)
-    if wire is not None and not jnp.issubdtype(x.dtype, jnp.floating):
-        # complex would silently lose its imaginary part in the codecs
-        raise ValueError(
-            f"wire compression needs a real float input, got {x.dtype}")
     acc = x * _table(sched.self_weight, idx, x.dtype)
     for r in range(sched.num_rounds):
         send = x

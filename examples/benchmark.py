@@ -157,11 +157,9 @@ def main():
         # error-compensated compressed gossip (defaults to int8 wire)
         strategy = bfopt.choco_gossip(opt, wire=args.wire or "int8")
     elif name == "win_put":
-        strategy = bfopt.DistributedWinPutOptimizer(
-            opt, **({"wire": args.wire} if args.wire else {}))
+        strategy = bfopt.DistributedWinPutOptimizer(opt, wire=args.wire)
     elif name == "pull_get":
-        strategy = bfopt.DistributedPullGetOptimizer(
-            opt, **({"wire": args.wire} if args.wire else {}))
+        strategy = bfopt.DistributedPullGetOptimizer(opt, wire=args.wire)
     elif name == "push_sum":
         strategy = bfopt.DistributedPushSumOptimizer(opt)
     else:

@@ -643,3 +643,31 @@ def test_choco_step_carries_int8_diffs_on_wire(tpu_mesh):
     # on the wire is s8 — f32 permutes may only carry the scalar scale
     assert len(payloads) == 3, [lines[l][:100] for l in starts]
     assert not any(re.search(r"f32\[\d{3,}", lines[l]) for l in starts)
+
+
+def test_win_put_wire_compresses_tpu_payload(tpu_mesh):
+    """The window delivery path shares the codec-pinned permute helper:
+    win_put(wire="bf16") on f32 windows carries bf16 permute payloads in
+    the compiled v5e schedule — never full-width f32 (round-4 feature;
+    the shared _wire_ppermute keeps the barrier subtlety in one place)."""
+    from bluefog_tpu.ops import windows as wops
+
+    sched = sch.compile_topology(tu.ExponentialTwoGraph(N))
+
+    def per_rank(x):
+        w = wops.win_create(x[0], sched)
+        w = wops.win_put(w, x[0], sched, axis="rank", wire="bf16")
+        return w.recv[None]
+
+    fn = jax.jit(jax.shard_map(
+        per_rank, mesh=tpu_mesh, in_specs=(P("rank"),),
+        out_specs=P("rank")))
+    x = jax.ShapeDtypeStruct(
+        (N, 1024, 1024), jnp.float32,
+        sharding=NamedSharding(tpu_mesh, P("rank")))
+    txt = fn.lower(x).compile().as_text()
+    starts = _op_lines(txt, "collective-permute-start")
+    lines = txt.splitlines()
+    payload = [l for l in starts if re.search(r"bf16\[", lines[l])]
+    assert len(payload) == 3, [lines[l] for l in starts]    # 3 Exp2 rounds
+    assert not any(re.search(r"f32\[\d{4,}", lines[l]) for l in starts)

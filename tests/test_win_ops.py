@@ -37,6 +37,37 @@ def test_win_create_update_default_weights():
             np.asarray(out[r]), np.full(DIM, expected[r]), rtol=1e-5)
 
 
+def test_named_window_wire_plumbs_through_registry():
+    """The registry layer (bf.win_put/win_get wire=) really reaches the
+    compressed delivery path: int8-wired puts land visibly quantized
+    values, a wire=None put through the same window is exact (distinct
+    jit-cache entries per wire mode), and the update result stays within
+    quantization tolerance of the exact combine."""
+    x = rank_tensor(lambda r: 0.1 * r + 0.01)
+    # independent windows per mode: win_update folds the combine back into
+    # the window value, so reusing one window would entangle the modes
+    for name in ("wa", "wb", "wc"):
+        assert bf.win_create(x, name, zero_init=True)
+
+    bf.win_put(x, "wa")
+    exact = np.asarray(bf.win_update("wa"))
+
+    bf.win_put(x, "wb", wire="int8")
+    quant = np.asarray(bf.win_update("wb"))
+    np.testing.assert_allclose(quant, exact, rtol=0.1, atol=0.02)
+    assert not np.array_equal(quant, exact)      # it really quantized
+
+    # the jit cache did not hand the wire="int8" executable back to a
+    # wire=None call (same shapes/schedule, different key)
+    bf.win_put(x, "wc")
+    again = np.asarray(bf.win_update("wc"))
+    np.testing.assert_array_equal(again, exact)
+
+    bf.win_get("wc", wire="bf16")
+    got = np.asarray(bf.win_update("wc"))
+    assert np.isfinite(got).all()
+
+
 def test_win_update_given_weights():
     x = rank_tensor()
     bf.win_create(x, "w1", zero_init=True)

@@ -50,6 +50,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-v", "--version", action="store_true",
                    help="print the bluefog_tpu version and exit "
                         "(reference: bfrun -v)")
+    p.add_argument("--check", action="store_true",
+                   help="print an environment diagnosis (platform, devices, "
+                        "native components, compile cache, bootstrap env) "
+                        "and exit; the horovodrun --check-build counterpart")
     hosts_group = p.add_mutually_exclusive_group()
     hosts_group.add_argument(
         "-H", "--hosts", default=None,
@@ -344,12 +348,68 @@ def _apply_coordinator_env(args, env) -> None:
         env["BLUEFOG_PROCESS_ID"] = str(args.process_id)
 
 
+def check_environment(stream=None) -> int:
+    """Print an environment diagnosis (``bfrun-tpu --check``).
+
+    Everything a stuck launch needs triaged: versions, the JAX platform the
+    axon/pod plugins will actually pick, device visibility (guarded by a
+    note rather than a hang when a tunnel is down), the native (C++)
+    component status, compile-cache config, and which BLUEFOG_* bootstrap
+    variables are set.
+    """
+    from .. import __version__
+
+    stream = stream if stream is not None else sys.stdout
+    w = lambda s: stream.write(s + "\n")
+    w(f"bluefog_tpu {__version__}")
+    import jax
+    import jaxlib
+
+    w(f"jax {jax.__version__} / jaxlib {jaxlib.__version__}")
+    w(f"jax_platforms config: {jax.config.jax_platforms!r} "
+      f"(JAX_PLATFORMS env: {os.environ.get('JAX_PLATFORMS')!r})")
+    tpu_env = {k: v for k, v in os.environ.items()
+               if k.startswith(("TPU_", "MEGASCALE_"))}
+    if tpu_env:
+        w("TPU env: " + ", ".join(f"{k}={v}" for k, v in
+                                  sorted(tpu_env.items())))
+    from ..utils.config import looks_like_tpu_environment
+    w(f"looks like TPU flag-parsing runtime: "
+      f"{looks_like_tpu_environment()}")
+    boot = {k: os.environ[k] for k in
+            ("BLUEFOG_COORDINATOR", "BLUEFOG_NUM_PROCESSES",
+             "BLUEFOG_PROCESS_ID", "BLUEFOG_NODES_PER_MACHINE",
+             "BLUEFOG_TIMELINE") if k in os.environ}
+    w(f"bootstrap env: {boot or '(none set)'}")
+    cache = os.environ.get("BLUEFOG_COMPILE_CACHE", "")
+    w(f"compile cache: {cache or '~/.cache/bluefog_tpu_xla (default)'}")
+    from .. import _native
+    w(f"native (C++) components: "
+      f"{'built' if _native.available() else 'pure-Python fallback'}")
+    # device probe LAST and clearly announced: on a tunnel-backed platform
+    # this can block for minutes when the relay is down.  Flush first —
+    # under a pipe/tee the buffered report would otherwise vanish with a
+    # ctrl-C, hiding exactly the diagnosis this flag exists for.
+    w("probing devices (may hang if a TPU tunnel is down; ctrl-C is safe)…")
+    stream.flush()
+    try:
+        devs = jax.devices()
+        w(f"devices: {len(devs)} x {devs[0].device_kind} "
+          f"({jax.process_count()} process(es))")
+    except Exception as e:                       # noqa: BLE001
+        w(f"device probe FAILED: {type(e).__name__}: {e}")
+        return 1
+    return 0
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
     if args.version:
         from .. import __version__
         print(f"bluefog_tpu {__version__}")
         return 0
+    if args.check:
+        return check_environment()
     if args.interactive_worker:
         if not args.controller:
             raise SystemExit("--interactive-worker requires --controller")

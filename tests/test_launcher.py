@@ -240,3 +240,18 @@ def test_hostfile_fanout_e2e(tmp_path):
     # without a command
     with pytest.raises(SystemExit):
         launcher.main(["-H", "x", "--hostfile", str(hf)])
+
+
+def test_check_environment(capsys):
+    """--check prints a full diagnosis and exits 0 when devices resolve
+    (CPU mesh here); the device probe comes LAST so everything else is
+    already printed if a dead TPU tunnel hangs it."""
+    assert launcher.check_environment() == 0
+    out = capsys.readouterr().out
+    assert "bluefog_tpu 0." in out
+    assert "jax " in out and "jax_platforms config" in out
+    assert "native (C++) components" in out
+    assert "compile cache" in out
+    lines = out.strip().splitlines()
+    assert lines[-2].startswith("probing devices")     # probe is last
+    assert lines[-1].startswith("devices: ")

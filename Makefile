@@ -62,6 +62,7 @@ test_examples:
 		--micro 4
 	$(PY) examples/pipeline_lm.py --virtual-cpu --steps 30 --hetero
 	$(PY) examples/llm_3d.py --virtual-cpu --steps 40
+	$(PY) examples/elastic_restart.py --virtual-cpu --steps 60
 
 # build the native (C++) components explicitly (otherwise built lazily)
 native:

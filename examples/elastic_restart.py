@@ -1,0 +1,140 @@
+"""Elastic checkpoint/resume: train on 8 ranks, crash, resume on 4.
+
+The failure-recovery walk the reference leaves to the user (SURVEY.md §5
+"failure detection / elastic recovery: minimal" — its flow is plain torch
+saves + ``broadcast_parameters`` after a manual restart).  Here the
+decentralized parameters — every rank's *different*, pre-consensus
+values — checkpoint as one pytree, and
+``checkpoint.resize_distributed`` re-targets it to a new world size, so a
+job that loses half its slice keeps training instead of starting over:
+
+1. 8 ranks train decentralized (CTA gossip) and checkpoint every K steps
+   (``AsyncSaver``: the save overlaps training).
+2. "Crash" — the script simply stops using the 8-rank mesh.
+3. A 4-rank mesh restores the latest checkpoint, ``resize_distributed``
+   maps the 8 rank-states onto 4 (survivors keep their local
+   trajectories), the topology recompiles for the smaller world, the
+   optimizer state re-initializes (moments are rank-local; gossip
+   re-mixes within a few steps), and training continues to the optimum.
+4. A wrecked-rank restart is also shown: rank 0's state re-seeds everyone
+   via ``broadcast_parameters`` (the reference's restart primitive).
+
+Run: python examples/elastic_restart.py --virtual-cpu
+"""
+import argparse
+import os
+import shutil
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--virtual-cpu", action="store_true")
+    parser.add_argument("--steps", type=int, default=120)
+    parser.add_argument("--checkpoint-every", type=int, default=30)
+    parser.add_argument("--dir", default=None,
+                        help="checkpoint directory (default: a tmp dir)")
+    parser.add_argument("--seed", type=int, default=0)
+    args = parser.parse_args()
+    if args.checkpoint_every < 1:
+        parser.error("--checkpoint-every must be >= 1")
+    if args.steps // 2 < args.checkpoint_every:
+        parser.error("--steps must be at least 2x --checkpoint-every "
+                     "(phase 1 must write at least one checkpoint to "
+                     "resume from)")
+
+    if args.virtual_cpu:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8").strip()
+
+    import jax
+    if args.virtual_cpu:
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    import bluefog_tpu as bf
+    from bluefog_tpu import checkpoint as ckpt
+    from bluefog_tpu import optimizers as bfopt
+    from bluefog_tpu import topology as tu
+    from bluefog_tpu.utils import broadcast_parameters
+
+    ckdir = args.dir or tempfile.mkdtemp(prefix="bf_elastic_")
+    D = 6
+    rng = np.random.default_rng(args.seed)
+    w_star = rng.normal(size=(D,))
+    A8 = rng.normal(size=(8, 20, D)).astype(np.float32)
+    b8 = (A8 @ w_star + 0.05 * rng.normal(size=(8, 20))).astype(np.float32)
+
+    def grad_fn(params, batch):
+        Ab, bb = batch
+        return jax.value_and_grad(
+            lambda p: jnp.mean((Ab @ p["w"] - bb) ** 2))(params)
+
+    def make(n, devices):
+        bf.init(devices=devices)
+        bf.set_topology(tu.ExponentialTwoGraph(n), is_weighted=True)
+        strat = bfopt.DistributedAdaptWithCombineOptimizer(
+            optax.adam(0.05), communication_type="neighbor_allreduce")
+        return strat, bfopt.make_train_step(grad_fn, strat)
+
+    # ---- phase 1: 8 ranks, checkpoint every K steps (async) -------------
+    devices = jax.devices()
+    strat, step = make(8, devices)
+    params = bfopt.replicate({"w": jnp.zeros((D,), jnp.float32)}, 8)
+    state = bfopt.init_distributed(strat, params)
+    batch = (jnp.asarray(A8), jnp.asarray(b8))
+    saver = ckpt.AsyncSaver()
+    half = args.steps // 2
+    for it in range(half):
+        params, state, loss = step(params, state, batch)
+        if (it + 1) % args.checkpoint_every == 0:
+            saver.save(ckdir, {"params": params}, step=it + 1)
+    saver.close()
+    l8 = float(np.asarray(loss).mean())
+    print(f"[8 ranks] step {half}: loss {l8:.4f}, "
+          f"checkpoints at {sorted(ckpt.all_steps(ckdir))}")
+    bf.shutdown()
+
+    # ---- phase 2: "crash"; resume on HALF the slice ----------------------
+    restored, at = ckpt.restore_latest(ckdir)
+    print(f"[resume] restored step {at} on a 4-rank world")
+    strat, step = make(4, devices[:4])
+    params4 = ckpt.resize_distributed(restored["params"], 4, mode="slice")
+    # fresh optimizer state on the new world (moments are rank-local)
+    state4 = bfopt.init_distributed(strat, params4)
+    batch4 = (jnp.asarray(A8[:4]), jnp.asarray(b8[:4]))
+    for _ in range(args.steps - half):
+        params4, state4, loss = step(params4, state4, batch4)
+    w4 = np.asarray(params4["w"])
+    # the 4-rank objective has its own optimum (first 4 shards)
+    AtA = sum(A8[r].T @ A8[r] for r in range(4))
+    Atb = sum(A8[r].T @ b8[r] for r in range(4))
+    w_opt4 = np.linalg.solve(AtA, Atb)
+    err = max(np.abs(w4[r] - w_opt4).max() for r in range(4))
+    print(f"[4 ranks] resumed and converged: max |w - w*| = {err:.3f}")
+    assert err < 0.35, "elastic resume failed to converge"
+
+    # ---- phase 3: wrecked-rank restart (reference flow) ------------------
+    wrecked = jax.tree.map(lambda t: t.at[2].set(jnp.nan), params4)
+    healed = broadcast_parameters(wrecked, root_rank=0)
+    assert np.isfinite(np.asarray(healed["w"])).all()
+    np.testing.assert_array_equal(np.asarray(healed["w"])[2],
+                                  np.asarray(params4["w"])[0])
+    print("[restart] rank 2 wrecked (NaN) -> re-seeded from rank 0 via "
+          "broadcast_parameters")
+    bf.shutdown()
+    if args.dir is None:
+        shutil.rmtree(ckdir, ignore_errors=True)
+    print(f"[elastic] 8-rank train -> crash -> 4-rank resume -> "
+          f"wrecked-rank heal: all OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -975,6 +975,117 @@ def zero_adapt_with_combine(
     return DecentralizedOptimizer(init, update, axes)
 
 
+def powersgd_allreduce(
+    opt: optax.GradientTransformation,
+    *,
+    compression_rank: int = 2,
+    min_compress_size: int = 2048,
+    axis: Axis = "rank",
+) -> DecentralizedOptimizer:
+    """Synchronous DP with PowerSGD rank-r gradient compression.
+
+    Beyond-reference bandwidth lever (Vogels et al., "PowerSGD: practical
+    low-rank gradient compression for distributed optimization", 2019 —
+    public technique): each matrix-shaped gradient ``M [m, k]`` is
+    allreduced as two rank-r factors, ``(m + k) * r`` values on the wire
+    instead of ``m * k`` (a 64x cut for a 1024x512 layer at r=4), with the
+    approximation error fed back into the next step so it decays instead
+    of accumulating.  One power-iteration per step, warm-started from last
+    step's factor:
+
+        M  = grad + error                  (error feedback)
+        P  = pmean(M @ Q);  P = qr(P).Q    (left factor, orthonormalized)
+        Q' = pmean(M.T @ P)                (right factor)
+        M^ = P @ Q'.T;  error = M - M^
+
+    All compute is two skinny matmuls + a tiny [m, r] QR — exactly the MXU
+    shape, unlike coordinate-wise quantizers.  The TPU fit is the point:
+    the wire savings pay on DCN-linked multi-slice DP, while the compress/
+    decompress cost is a rounding error next to the model matmuls.
+
+    Leaves below ``min_compress_size`` elements or with fewer than 2 dims
+    (biases, norms, scalars) are allreduced exactly.  ``Q`` is initialized
+    identically on every rank (deterministic per-leaf key) and stays
+    identical by construction (it only ever updates from pmean'd values),
+    which is what makes the factor allreduces well-defined.  Compression
+    runs in f32 regardless of the gradient dtype for a stable power
+    iteration.  Like :func:`gradient_allreduce`, the trajectory keeps all
+    ranks bitwise in lock-step.
+    """
+    if compression_rank < 1:
+        raise ValueError(f"compression_rank must be >= 1, got "
+                         f"{compression_rank}")
+    r = compression_rank
+
+    def _compressible(x):
+        return x.ndim >= 2 and x.size >= min_compress_size
+
+    def _mk(x):
+        return int(np.prod(x.shape[:-1])), int(x.shape[-1])
+
+    def init(params):
+        leaves = jax.tree.leaves(params)
+        errs, qs = [], []
+        for i, p in enumerate(leaves):
+            if not _compressible(p):
+                continue
+            m, k = _mk(p)
+            key = jax.random.fold_in(jax.random.key(17), i)
+            qs.append(jax.random.normal(key, (k, min(r, m, k)),
+                                        jnp.float32))
+            errs.append(jnp.zeros((m, k), jnp.float32))
+        return DecentralizedState(
+            jnp.zeros((), jnp.int32), opt.init(params),
+            (tuple(errs), tuple(qs)))
+
+    def update(grads, state, params):
+        errs, qs = state.comm_state
+        leaves, treedef = jax.tree.flatten(grads)
+        new_errs, new_qs = [], []
+        out: list = [None] * len(leaves)
+        ci = 0
+        for i, g in enumerate(leaves):
+            if not _compressible(g):
+                continue
+            m, k = _mk(g)
+            M = g.reshape(m, k).astype(jnp.float32) + errs[ci]
+            # COMMUNICATE scopes the collectives only — the compress/
+            # decompress matmuls and the QR are compute, and mislabeling
+            # them would skew the trace-derived comm/compute split
+            with named_span("COMMUNICATE"):
+                P = lax.pmean(M @ qs[ci], axis)          # [m, r]
+            P = jnp.linalg.qr(P, mode="reduced")[0]
+            with named_span("COMMUNICATE"):
+                Qn = lax.pmean(M.T @ P, axis)            # [k, r]
+            Mhat = P @ Qn.T
+            new_errs.append(M - Mhat)
+            # pmean outputs are VMA-unvarying, but the carried state
+            # entered varying (replicate/shard flow) — recast so scan
+            # carries type-match under VMA checking
+            new_qs.append(lax.pcast(Qn, axis, to="varying")
+                          if axis in getattr(jax.typeof(qs[ci]), "vma",
+                                             ()) else Qn)
+            out[i] = Mhat.reshape(g.shape).astype(g.dtype)
+            ci += 1
+        # exact-path leaves (biases, norms, scalars) reduce in ONE fused
+        # allreduce per dtype — not dozens of latency-bound tiny
+        # collectives on exactly the links PowerSGD targets
+        exact_idx = [i for i, o in enumerate(out) if o is None]
+        if exact_idx:
+            with named_span("COMMUNICATE"):
+                reduced = fusion.fused_leaf_op(
+                    lambda x: lax.pmean(x, axis))(
+                    [leaves[i] for i in exact_idx])
+            for i, rg in zip(exact_idx, reduced):
+                out[i] = rg
+        ghat = jax.tree.unflatten(treedef, out)
+        new_params, opt_state = _apply(opt, ghat, state.opt_state, params)
+        return new_params, DecentralizedState(
+            state.step + 1, opt_state, (tuple(new_errs), tuple(new_qs)))
+
+    return DecentralizedOptimizer(init, update)
+
+
 # ---------------------------------------------------------------------------
 # Reference-named factories (the familiar surface)
 # ---------------------------------------------------------------------------

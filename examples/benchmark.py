@@ -29,7 +29,8 @@ def main():
                         choices=["neighbor_allreduce", "gradient_allreduce",
                                  "zero_allreduce", "choco",
                                  "allreduce", "hierarchical_neighbor_allreduce",
-                                 "win_put", "pull_get", "push_sum", "empty"])
+                                 "win_put", "pull_get", "push_sum",
+                                 "powersgd", "empty"])
     parser.add_argument("--atc", action="store_true")
     parser.add_argument("--wire", default=None, choices=["bf16", "int8"],
                         help="compress gossip bytes on the wire "
@@ -144,7 +145,7 @@ def main():
 
     name = args.dist_optimizer
     if args.wire and name in ("gradient_allreduce", "zero_allreduce",
-                              "push_sum", "allreduce", "empty"):
+                              "push_sum", "allreduce", "powersgd", "empty"):
         raise SystemExit(
             f"--wire applies to the gossip strategies (neighbor/"
             f"hierarchical/win_put/pull_get/choco), not {name}")
@@ -162,6 +163,9 @@ def main():
         strategy = bfopt.DistributedPullGetOptimizer(opt, wire=args.wire)
     elif name == "push_sum":
         strategy = bfopt.DistributedPushSumOptimizer(opt)
+    elif name == "powersgd":
+        # rank-r low-rank gradient compression (error feedback)
+        strategy = bfopt.powersgd_allreduce(opt, compression_rank=4)
     else:
         factory = (bfopt.DistributedAdaptThenCombineOptimizer if args.atc
                    else bfopt.DistributedAdaptWithCombineOptimizer)

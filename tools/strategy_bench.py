@@ -171,6 +171,8 @@ def main():
         "push_sum": lambda: bfopt.push_sum(opt()),
         "zero-1 allreduce": lambda: bfopt.zero_gradient_allreduce(opt()),
         "choco (int8 wire)": lambda: bfopt.choco_gossip(opt()),
+        "powersgd r=4": lambda: bfopt.powersgd_allreduce(
+            opt(), compression_rank=4),
         "neighbor bf16 wire": lambda: bfopt.adapt_with_combine(
             opt(), bfopt.neighbor_communicator(bf.static_schedule(),
                                                wire="bf16")),

@@ -5,6 +5,9 @@ problem (error feedback makes the rank-r approximation error decay),
 projection exactness at full rank, rank lock-step, small-leaf exactness,
 and the wire-bytes cut in the compiled v5e schedule.
 """
+import os
+import sys
+
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -15,6 +18,10 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 import bluefog_tpu as bf
 from bluefog_tpu import optimizers as bfopt
 from bluefog_tpu import topology as tu
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir,
+                                "tools"))
+from strategy_bench import wire_stats  # noqa: E402
 
 N, D, C = 8, 8, 16
 
@@ -137,13 +144,6 @@ def test_powersgd_wire_bytes_cut_on_v5e():
 
     sds = lambda shape: jax.ShapeDtypeStruct(
         (N,) + shape, jnp.float32, sharding=NamedSharding(mesh, P("rank")))
-    import re
-    import sys
-    import os
-    sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir,
-                                    "tools"))
-    from strategy_bench import wire_stats
-
     txt = make(strat).lower(
         sds((m, k)), sds((m, k)), sds((k, r))).compile().as_text()
     _, bytes_c = wire_stats(txt)

@@ -350,7 +350,7 @@ def _mailbox_optimizer(
         new_params, opt_state = _apply(opt, grads, state.opt_state, combined)
         return new_params, DecentralizedState(state.step + 1, opt_state, windows)
 
-    return DecentralizedOptimizer(init, update)
+    return DecentralizedOptimizer(init, update, (axis,))
 
 
 def win_put_optimizer(
@@ -503,7 +503,7 @@ def push_sum(
         return new_params, DecentralizedState(
             state.step + 1, opt_state, (windows, p_windows))
 
-    return DecentralizedOptimizer(init, update)
+    return DecentralizedOptimizer(init, update, (axis,))
 
 
 def choco_gossip(

@@ -147,13 +147,31 @@ class Controller:
         self.port = self._srv.getsockname()[1]
         self._workers: Dict[int, socket.socket] = {}
         self._lock = threading.Lock()
+        self._aborted: Optional[str] = None
+
+    def abort(self, reason: str) -> None:
+        """Make a blocked :meth:`wait_for_workers` raise ``reason`` now.
+
+        Closing the listener does NOT wake a thread blocked in accept();
+        instead the flag is set and a wake-up connection is dialed to our
+        own port (a launcher monitor calls this when a spawned worker
+        dies before connecting)."""
+        self._aborted = reason
+        with contextlib.suppress(OSError):
+            socket.create_connection(("127.0.0.1", self.port),
+                                     timeout=5).close()
 
     def wait_for_workers(self, timeout: float = 300.0) -> List[int]:
         """Block until all workers have connected + handshaken; returns the
         sorted process ids."""
         self._srv.settimeout(timeout)
         while len(self._workers) < self.num_workers:
+            if self._aborted:
+                raise RuntimeError(self._aborted)
             conn, _ = self._srv.accept()
+            if self._aborted:
+                conn.close()
+                raise RuntimeError(self._aborted)
             # accepted sockets do NOT inherit the listener timeout; a
             # connected-but-silent peer must not block startup forever
             conn.settimeout(timeout)

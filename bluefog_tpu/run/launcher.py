@@ -26,9 +26,12 @@ environment plus BLUEFOG_* variables are always passed through.
 
 Interactive mode (reference: ``ibfrun``): ``--interactive`` alone opens a
 single-process REPL (SPMD makes every rank visible in one process);
-``--interactive -np N`` drives N spawned SPMD workers from a local REPL; on
-real multi-host clusters run ``--interactive-worker`` on each host and
-``--interactive --num-processes N`` on the driver (see ``interactive.py``).
+``--interactive -np N`` drives N spawned SPMD workers from a local REPL;
+``--interactive -H host1,host2`` SSH-starts the workers itself (the
+one-command remote ibfrun — the session token travels over each ssh
+stdin, never argv); or run ``--interactive-worker`` on each host manually
+with ``--interactive --num-processes N`` on the driver (see
+``interactive.py``).
 """
 from __future__ import annotations
 
@@ -111,6 +114,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--listen-port", type=int, default=0,
                    help="port the interactive controller listens on "
                         "(default: ephemeral, printed at start)")
+    p.add_argument("--advertise", default=None,
+                   help="address (host:port) remote interactive workers "
+                        "dial back to with --interactive -H (default: "
+                        "this hostname + the listen port)")
+    p.add_argument("--remote-python", default="python3",
+                   help="interpreter to run interactive workers with on "
+                        "-H hosts (e.g. /path/to/venv/bin/python)")
     p.add_argument("command", nargs=argparse.REMAINDER,
                    help="the training command, e.g. python train.py")
     return p
@@ -278,11 +288,17 @@ def _multihost_fanout(args, env) -> int:
 def _interactive_cluster(args, env) -> int:
     """Multi-host interactive session (the ibfrun counterpart): drive N SPMD
     workers from a local REPL.  ``-np N`` spawns the workers here (local
-    emulation, like `ibfrun -np`); ``--num-processes N`` without -np waits
-    for N remote ``--interactive-worker`` hosts to dial in."""
+    emulation, like `ibfrun -np`); ``-H host1,host2`` (or ``--hostfile``)
+    SSH-starts one worker per slot — the one-command remote ibfrun;
+    ``--num-processes N`` alone waits for manually started
+    ``--interactive-worker`` hosts to dial in."""
     from .interactive import Controller, repl
 
-    n = args.num_local_processes or args.num_processes
+    hosts = (parse_hostfile(args.hostfile) if args.hostfile
+             else parse_hosts(args.hosts) if args.hosts else None)
+    n = (args.num_local_processes if args.num_local_processes
+         else sum(s for _, s in hosts) if hosts
+         else args.num_processes)
     # local spawn keeps the cell socket on loopback; remote-worker mode must
     # listen on all interfaces — either way cells only execute for peers
     # presenting the session token
@@ -298,12 +314,66 @@ def _interactive_cluster(args, env) -> int:
             n, args.coordinator or "127.0.0.1:48293", env,
             [sys.executable, "-m", "bluefog_tpu.run.interactive",
              "--connect", f"127.0.0.1:{ctrl.port}"])
+    elif hosts:
+        # one-command remote ibfrun: SSH-start every worker via the -H
+        # fan-out plan.  The session token travels over each ssh STDIN
+        # (`read` in the remote shell), never the ps-visible argv.
+        import socket as _socket
+
+        me = args.advertise or f"{_socket.gethostname()}:{ctrl.port}"
+        worker_cmd = [args.remote_python, "-m",
+                      "bluefog_tpu.run.interactive", "--connect", me]
+        plans = build_multihost_plan(
+            hosts, worker_cmd, cwd=os.getcwd(),
+            coordinator=args.coordinator, base_env=env, extra_env=args.env,
+            remote_shell=args.remote_shell, ssh_port=args.ssh_port)
+        for host_, pid, argv in plans:
+            # prefix the remote command with a token read from stdin
+            argv = argv[:-1] + [
+                "IFS= read -r BLUEFOG_SESSION_TOKEN; "
+                "export BLUEFOG_SESSION_TOKEN; " + argv[-1]]
+            print(f"bfrun-tpu: starting interactive worker {pid} on "
+                  f"{host_}", flush=True)
+            p = subprocess.Popen(argv, stdin=subprocess.PIPE)
+            p.stdin.write((ctrl.token + "\n").encode())
+            p.stdin.close()
+            procs.append(p)
+        # a dead spawn (bad host, auth failure, missing interpreter) must
+        # surface immediately, not as a silent 300 s accept timeout
+        import threading as _threading
+
+        ready = _threading.Event()
+
+        def _monitor():
+            while not ready.is_set():
+                for p_ in procs:
+                    if p_.poll() not in (None, 0):
+                        print(f"bfrun-tpu: an interactive worker exited "
+                              f"with code {p_.returncode} before "
+                              "connecting — check host/interpreter "
+                              "(--remote-python) and ssh access",
+                              file=sys.stderr, flush=True)
+                        ctrl.abort(
+                            f"a worker spawn exited with code "
+                            f"{p_.returncode} before connecting")
+                        return
+                _time.sleep(0.5)
+
+        import time as _time
+        _threading.Thread(target=_monitor, daemon=True).start()
     else:
         # remote workers need the token out of band (notebook-server style)
         print("session token (pass to each worker via --session-token or "
               f"BLUEFOG_SESSION_TOKEN): {ctrl.token}", flush=True)
     try:
-        ranks = ctrl.wait_for_workers()
+        try:
+            ranks = ctrl.wait_for_workers()
+        except (OSError, RuntimeError) as exc:
+            raise SystemExit(
+                f"interactive workers failed to connect ({exc}); see the "
+                "worker-exit diagnosis above") from exc
+        if hosts:
+            ready.set()
         print(f"workers ready: ranks {ranks}", flush=True)
         repl(ctrl)
     finally:
@@ -423,7 +493,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         return subprocess.call(
             [sys.executable, "-m", "bluefog_tpu.run.interactive",
              "--connect", args.controller], env=env)
-    if args.interactive and (args.num_local_processes or args.num_processes):
+    if args.interactive and (args.num_local_processes or args.num_processes
+                             or args.hosts or args.hostfile):
         return _interactive_cluster(args, _child_env(args))
     if args.interactive:
         env = _child_env(args)

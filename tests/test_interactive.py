@@ -277,3 +277,53 @@ def test_two_worker_interactive_session():
     assert all(p.returncode == 0 for p in procs), [
         (p.returncode, open(f"/tmp/interactive_worker_{i}.log").read()[-2000:])
         for i, p in enumerate(procs)]
+
+
+@pytest.mark.slow
+def test_one_command_remote_interactive_via_H(tmp_path):
+    """`bfrun-tpu --interactive -H hA,hB`: the controller SSH-starts every
+    worker itself (stub shell), delivers the session token over each ssh
+    STDIN (never argv), the workers form one jax.distributed mesh and
+    execute a REPL cell — the one-command remote ibfrun."""
+    stub = tmp_path / "fake_ssh"
+    stub.write_text('#!/bin/sh\nshift\nexec sh -c "$@"\n')
+    stub.chmod(0o755)
+    port = _free_port()
+    env = dict(os.environ)
+    env.pop("BLUEFOG_COORDINATOR", None)
+    env.pop("BLUEFOG_SESSION_TOKEN", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    r = subprocess.run(
+        [sys.executable, "-m", "bluefog_tpu.run.launcher",
+         "--interactive", "-H", "hA,hB", "--remote-shell", str(stub),
+         "--listen-port", str(port), "--advertise", f"127.0.0.1:{port}",
+         "--coordinator", f"127.0.0.1:{_free_port()}"],
+        input="import jax; print(bf.size(), jax.process_count())\n",
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=420)
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+    assert "workers ready: ranks [0, 1]" in r.stdout, r.stdout
+    assert "2 2" in r.stdout, r.stdout              # 2 ranks x 1 device
+    # the RCE-gating token never reaches any command line
+    assert "BLUEFOG_SESSION_TOKEN=" not in r.stdout
+
+
+def test_remote_interactive_dead_spawn_fails_fast(tmp_path):
+    """A worker spawn that dies (bad host/interpreter) surfaces within
+    seconds — not as a silent 300 s accept timeout."""
+    import time
+    stub = tmp_path / "fake_ssh"
+    stub.write_text("#!/bin/sh\nexit 7\n")
+    stub.chmod(0o755)
+    env = dict(os.environ)
+    env.pop("BLUEFOG_SESSION_TOKEN", None)
+    t0 = time.perf_counter()
+    r = subprocess.run(
+        [sys.executable, "-m", "bluefog_tpu.run.launcher",
+         "--interactive", "-H", "deadhost", "--remote-shell", str(stub)],
+        input="", env=env, cwd=REPO, capture_output=True, text=True,
+        timeout=120)
+    assert r.returncode != 0
+    assert "exited with code 7" in r.stderr, r.stderr[-1500:]
+    assert "failed to connect" in r.stderr, r.stderr[-1500:]
+    assert time.perf_counter() - t0 < 60

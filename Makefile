@@ -5,7 +5,7 @@
 PY ?= python
 
 .PHONY: test test_basic test_ops test_win_ops test_optimizer test_hier \
-	test_native test_examples verify native clean
+	test_native test_examples verify native clean hw-watch
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -63,6 +63,11 @@ test_examples:
 	$(PY) examples/pipeline_lm.py --virtual-cpu --steps 30 --hetero
 	$(PY) examples/llm_3d.py --virtual-cpu --steps 40
 	$(PY) examples/elastic_restart.py --virtual-cpu --steps 60
+
+# background TPU-tunnel watcher: probes every ~10 min, runs the full
+# measurement battery unattended on the first success (tools/hw_watch.py)
+hw-watch:
+	nohup $(PY) tools/hw_watch.py > hw_watch.out 2>&1 &
 
 # build the native (C++) components explicitly (otherwise built lazily)
 native:

@@ -23,7 +23,19 @@ env-tunable:
   BLUEFOG_BENCH_FORCE_CPU=1      skip probing, run the CPU fallback
   BLUEFOG_BENCH_BATCH / _ITERS / _STEPS_PER_CALL   workload overrides
   BLUEFOG_BENCH_IMAGE_SIZE / _CLASSES   shrink the model for CI smoke tests
+
+Probe outcomes are remembered in ``.probe_state.json`` (written here and by
+tools/hw_watch.py): when the last probe FAILED within
+``BLUEFOG_BENCH_PROBE_MEMORY_SECS`` (default 3600), the schedule collapses
+to ``BLUEFOG_BENCH_FAST_ATTEMPTS`` (default 1) x
+``BLUEFOG_BENCH_FAST_TIMEOUT`` (default 120 s) so a driver-run CPU fallback
+lands in ~2 minutes instead of 13.5.  Fresh probes (no state, stale state,
+or a recent success) use the full schedule.  All tunnel dials happen under
+the cross-process ``.tunnel.lock`` flock shared with tools/hw_watch.py
+(single-client relay).
 """
+import contextlib
+import fcntl
 import json
 import os
 import subprocess
@@ -66,6 +78,75 @@ def _env_float(name, default):
         return default
 
 
+PROBE_STATE_FILE = os.environ.get(
+    "BLUEFOG_PROBE_STATE",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                 ".probe_state.json"))
+# env-overridable so tests can point contention checks at a scratch file
+# instead of flocking/unlinking the real repo-root lock under a live watcher
+TUNNEL_LOCK_FILE = os.environ.get(
+    "BLUEFOG_TUNNEL_LOCK",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), ".tunnel.lock"))
+
+
+@contextlib.contextmanager
+def tunnel_client_lock(wait_s=None, poll_s=5.0):
+    """Cooperative single-client lock for the axon tunnel.
+
+    The relay wedges under concurrent connections, so every process that
+    may dial it (this benchmark, tools/hw_watch.py) takes this flock
+    first.  Yields True when held; False when the wait timed out (caller
+    must then stay off the tunnel).  flock is released by the kernel on
+    process death — no stale-lock handling needed."""
+    if wait_s is None:
+        wait_s = _env_float("BLUEFOG_BENCH_TUNNEL_WAIT", 900.0)
+    fd = os.open(TUNNEL_LOCK_FILE, os.O_CREAT | os.O_RDWR, 0o644)
+    deadline = time.monotonic() + wait_s
+    held = False
+    try:
+        while True:
+            try:
+                fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+                held = True
+                break
+            except OSError:
+                if time.monotonic() >= deadline:
+                    break
+                time.sleep(poll_s)
+        yield held
+    finally:
+        if held:
+            try:
+                fcntl.flock(fd, fcntl.LOCK_UN)
+            except OSError:
+                pass
+        os.close(fd)
+
+
+def read_probe_state():
+    """Last recorded probe outcome ({"ts", "ok", ...}) or None."""
+    try:
+        with open(PROBE_STATE_FILE) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def write_probe_state(ok: bool, seconds: float, writer: str = "bench"):
+    """Atomically record a probe outcome for later runs (and hw_watch)."""
+    doc = {"ts": time.time(), "ok": bool(ok), "seconds": round(seconds, 1),
+           "writer": writer,
+           "utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())}
+    tmp = PROBE_STATE_FILE + f".tmp{os.getpid()}"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, PROBE_STATE_FILE)
+    except OSError:
+        with contextlib.suppress(OSError):
+            os.unlink(tmp)            # read-only checkout: state is optional
+
+
 def _start_probe(env) -> "subprocess.Popen":
     """Probe accelerator init in a subprocess: the axon TPU plugin dials a
     tunnel during PJRT client creation, which hangs indefinitely when the
@@ -103,9 +184,24 @@ def probe_accelerator():
     """
     from bluefog_tpu.utils.config import RECOMMENDED_TPU_XLA_FLAGS
 
-    attempts = _env_int("BLUEFOG_BENCH_PROBE_ATTEMPTS", 3)
-    timeout = _env_float("BLUEFOG_BENCH_PROBE_TIMEOUT", 240.0)
-    sleep = _env_float("BLUEFOG_BENCH_PROBE_SLEEP", 45.0)
+    # fast-fallback: a recent recorded FAILURE (this process, an earlier
+    # bench run, or the hw_watch loop) shortens the schedule — after two
+    # rounds of 100% probe failure the driver should reach the CPU fallback
+    # in ~2 minutes, not 13.5 (round-4 verdict, weak #2)
+    state = read_probe_state()
+    memory = _env_float("BLUEFOG_BENCH_PROBE_MEMORY_SECS", 3600.0)
+    fast = bool(state) and not state.get("ok", True) \
+        and (time.time() - state.get("ts", 0)) < memory
+    if fast:
+        # distinct knobs: an exported full-schedule PROBE_ATTEMPTS must not
+        # silently defeat the ~2-minute fast-fallback guarantee
+        attempts = _env_int("BLUEFOG_BENCH_FAST_ATTEMPTS", 1)
+        timeout = _env_float("BLUEFOG_BENCH_FAST_TIMEOUT", 120.0)
+        sleep = _env_float("BLUEFOG_BENCH_PROBE_SLEEP", 15.0)
+    else:
+        attempts = _env_int("BLUEFOG_BENCH_PROBE_ATTEMPTS", 3)
+        timeout = _env_float("BLUEFOG_BENCH_PROBE_TIMEOUT", 240.0)
+        sleep = _env_float("BLUEFOG_BENCH_PROBE_SLEEP", 45.0)
     tuned_timeout = _env_float("BLUEFOG_BENCH_TUNED_TIMEOUT", 180.0)
 
     tuned_flags = (RECOMMENDED_TPU_XLA_FLAGS + " "
@@ -122,6 +218,7 @@ def probe_accelerator():
               file=sys.stderr)
         if attempt < attempts - 1:
             time.sleep(sleep)
+    write_probe_state(on_accelerator, time.monotonic() - t0)
     tuned_ok = False
     if on_accelerator and _probe(
             dict(os.environ, XLA_FLAGS=tuned_flags), tuned_timeout):
@@ -131,6 +228,7 @@ def probe_accelerator():
         "probe_attempts": used,
         "probe_seconds": round(time.monotonic() - t0, 1),
         "probe_tuned_flags": tuned_ok,
+        "probe_fast_path": fast,
     }
     return on_accelerator, info
 
@@ -315,40 +413,63 @@ def main():
         return
 
     orig_xla_flags = os.environ.get("XLA_FLAGS")
-    on_accelerator, probe_info = probe_accelerator()
-    if not on_accelerator:
-        print("bench: accelerator unreachable, falling back to CPU "
-              "(tiny shapes; the number is NOT the TPU headline)",
-              file=sys.stderr)
-        print(json.dumps(run_bench(False, probe_info)))
-        return
+    # hold the single-client tunnel lock for every path that may dial the
+    # relay (probe AND on-accelerator measurement): a concurrent hw_watch
+    # probe during a driver-run bench would wedge the relay for both.  The
+    # lock is RELEASED before any pure-CPU work so a watcher keeps sampling
+    # while the fallback grinds.  BLUEFOG_BENCH_TUNNEL_LOCK=0 is set by
+    # hw_watch for its battery children — the parent already holds the lock.
+    if os.environ.get("BLUEFOG_BENCH_TUNNEL_LOCK") == "0":
+        lock_cm = contextlib.nullcontext(True)
+    else:
+        lock_cm = tunnel_client_lock()
+    with contextlib.ExitStack() as stack:
+        held = stack.enter_context(lock_cm)
+        if not held:
+            stack.close()
+            print("bench: tunnel held by another client (hw_watch battery in "
+                  "flight?) past the wait budget; CPU fallback", file=sys.stderr)
+            print(json.dumps(run_bench(False, {
+                "probe_attempts": 0, "probe_seconds": 0.0,
+                "probe_tuned_flags": False, "probe_fast_path": False,
+                "tunnel_busy": True})))
+            return
+        on_accelerator, probe_info = probe_accelerator()
+        if not on_accelerator:
+            stack.close()             # CPU-only from here: free the tunnel
+            print("bench: accelerator unreachable, falling back to CPU "
+                  "(tiny shapes; the number is NOT the TPU headline)",
+                  file=sys.stderr)
+            print(json.dumps(run_bench(False, probe_info)))
+            return
 
-    try:
-        print(json.dumps(run_bench(True, probe_info)))
-    except Exception as e:          # noqa: BLE001 — the artifact must land
-        import traceback
-        traceback.print_exc()
-        reason = f"{type(e).__name__}: {e}"
-        rc, doc = _cpu_fallback_subprocess(
-            probe_info, reason, orig_xla_flags)
-        if doc is None:
-            # the fallback died without printing valid JSON (e.g. killed by
-            # a native abort) — the contract is one valid line no matter what
-            print(json.dumps({
-                "metric": "resnet50_synthetic_imgs_per_sec_per_chip",
-                "value": 0.0,
-                "unit": "img/s/chip",
-                "vs_baseline": 0.0,
-                "ok": False,
-                "error": reason[:400],
-                "fallback_rc": rc,
-                **probe_info,
-            }))
-        # a doubly-failed run must not read as a successful measurement:
-        # exit non-zero whenever the landed artifact is a rescue line
-        # (round-3 advisor item — drivers checking exit status alone)
-        if doc is None or not doc.get("ok", False):
-            sys.exit(1)
+        try:
+            print(json.dumps(run_bench(True, probe_info)))
+        except Exception as e:      # noqa: BLE001 — the artifact must land
+            import traceback
+            traceback.print_exc()
+            reason = f"{type(e).__name__}: {e}"
+            stack.close()             # retry subprocess is CPU-only
+            rc, doc = _cpu_fallback_subprocess(
+                probe_info, reason, orig_xla_flags)
+            if doc is None:
+                # the fallback died without printing valid JSON (e.g. killed
+                # by a native abort) — the contract is one valid line always
+                print(json.dumps({
+                    "metric": "resnet50_synthetic_imgs_per_sec_per_chip",
+                    "value": 0.0,
+                    "unit": "img/s/chip",
+                    "vs_baseline": 0.0,
+                    "ok": False,
+                    "error": reason[:400],
+                    "fallback_rc": rc,
+                    **probe_info,
+                }))
+            # a doubly-failed run must not read as a successful measurement:
+            # exit non-zero whenever the landed artifact is a rescue line
+            # (round-3 advisor item — drivers checking exit status alone)
+            if doc is None or not doc.get("ok", False):
+                sys.exit(1)
 
 
 if __name__ == "__main__":

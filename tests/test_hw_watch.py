@@ -1,0 +1,199 @@
+"""Smoke tests for the hardware watcher (tools/hw_watch.py) and bench.py's
+fast-fallback probe schedule.
+
+All probes are stubbed — nothing here dials the tunnel — and every lock /
+state path is redirected into tmp_path via the env overrides
+(BLUEFOG_HW_WATCH_LOCK / BLUEFOG_TUNNEL_LOCK / BLUEFOG_PROBE_STATE /
+BLUEFOG_MEASURED_DIR), so a live watcher on this checkout is never
+disturbed.  The watcher is round-5 automation for catching TPU-tunnel
+uptime unattended; the probe state file it shares with bench.py is what
+shortens the driver's CPU fallback from 13.5 minutes to ~2 (round-4
+verdict, weak #2).
+"""
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+WATCH = os.path.join(REPO, "tools", "hw_watch.py")
+
+
+@pytest.fixture
+def paths(tmp_path):
+    return {
+        "BLUEFOG_MEASURED_DIR": str(tmp_path / "measured"),
+        "BLUEFOG_HW_WATCH_LOCK": str(tmp_path / "hw.lock"),
+        "BLUEFOG_TUNNEL_LOCK": str(tmp_path / "tunnel.lock"),
+        "BLUEFOG_PROBE_STATE": str(tmp_path / "probe_state.json"),
+    }
+
+
+def _run(*args, paths, env=None):
+    e = dict(os.environ, **paths)
+    if env:
+        e.update(env)
+    return subprocess.run(
+        [sys.executable, WATCH, *args], cwd=REPO, env=e,
+        capture_output=True, text=True, timeout=120)
+
+
+def _load_bench(monkeypatch=None, paths=None):
+    if monkeypatch and paths:
+        for k, v in paths.items():
+            monkeypatch.setenv(k, v)
+    spec = importlib.util.spec_from_file_location(
+        "bench_for_test", os.path.join(REPO, "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_failed_probe_writes_state_and_log(paths, tmp_path):
+    p = _run("--once", "--stub-probe", "false", "--no-commit",
+             "--tag", "smoketest", paths=paths)
+    assert p.returncode == 1
+    state = json.load(open(paths["BLUEFOG_PROBE_STATE"]))
+    assert state["ok"] is False
+    assert state["writer"] == "hw_watch"
+    assert abs(state["ts"] - time.time()) < 120
+    log = open(os.path.join(paths["BLUEFOG_MEASURED_DIR"],
+                            "hw_watch_probes.log")).read()
+    assert "ok=False" in log
+
+
+def test_successful_probe_fires_battery_once(paths):
+    p = _run("--once", "--stub-probe", "true", "--stub-battery",
+             "--no-commit", "--tag", "smoketest", paths=paths)
+    assert p.returncode == 0, p.stderr
+    m = paths["BLUEFOG_MEASURED_DIR"]
+    doc = json.load(open(os.path.join(m, "battery_smoketest.json")))
+    assert doc["steps"]["stub"]["rc"] == 0
+    assert json.load(open(os.path.join(m, "bench_smoketest.json"))) == \
+        {"stub": True}
+    assert json.load(open(paths["BLUEFOG_PROBE_STATE"]))["ok"] is True
+
+
+def test_lockfile_excludes_second_instance(paths):
+    import fcntl
+    fd = os.open(paths["BLUEFOG_HW_WATCH_LOCK"], os.O_CREAT | os.O_RDWR)
+    fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)   # this test = live holder
+    try:
+        p = _run("--once", "--stub-probe", "true", "--no-commit", paths=paths)
+        assert p.returncode == 3
+        assert "another instance" in p.stderr
+    finally:
+        os.close(fd)
+
+
+def test_stale_lock_is_taken_over(paths):
+    # a lock FILE left by a dead watcher holds no flock → next start wins
+    with open(paths["BLUEFOG_HW_WATCH_LOCK"], "w") as f:
+        f.write("999999999")
+    p = _run("--once", "--stub-probe", "false", "--no-commit",
+             "--tag", "smoketest", paths=paths)
+    assert p.returncode == 1            # probe failed, but lock was taken
+    assert not os.path.exists(paths["BLUEFOG_HW_WATCH_LOCK"])
+
+
+def test_tunnel_lock_contention(paths, monkeypatch):
+    """bench and the watcher share one tunnel-client flock: when another
+    client holds it, the watcher skips the cycle (rc 4) and bench's
+    tunnel_client_lock reports not-held within its wait budget."""
+    import fcntl
+    bench = _load_bench(monkeypatch, paths)
+    fd = os.open(bench.TUNNEL_LOCK_FILE, os.O_CREAT | os.O_RDWR)
+    fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+    try:
+        p = _run("--once", "--stub-probe", "true", "--no-commit",
+                 "--tag", "smoketest", paths=paths)
+        assert p.returncode == 4
+        log = open(os.path.join(paths["BLUEFOG_MEASURED_DIR"],
+                                "hw_watch_probes.log")).read()
+        assert "tunnel-busy" in log
+        with bench.tunnel_client_lock(wait_s=0.5, poll_s=0.1) as held:
+            assert held is False
+    finally:
+        os.close(fd)
+    with bench.tunnel_client_lock(wait_s=0.5) as held:
+        assert held is True             # free lock acquires instantly
+
+
+def test_battery_resolves_steps_at_fire_time(paths):
+    # the battery list must include lm_bench/trace_analyze/perf_fill only
+    # when the files exist — resolved when the probe succeeds, not at start
+    spec = importlib.util.spec_from_file_location("hw_watch", WATCH)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    names = [s[0] for s in mod._battery_steps("x")]
+    assert names[:4] == ["bench", "tpu_validate", "chip_calibrate",
+                         "step_sweep"]
+    for optional in ("lm_bench", "trace_analyze", "perf_fill"):
+        tool = os.path.join(REPO, "tools", f"{optional}.py")
+        assert (optional in names) == os.path.exists(tool)
+
+
+# ---------- bench.py fast-fallback schedule ----------
+
+def test_bench_fast_path_after_recent_failure(paths, monkeypatch):
+    bench = _load_bench(monkeypatch, paths)
+    calls = []
+    monkeypatch.setattr(bench, "_probe",
+                        lambda env, timeout: calls.append(timeout) or False)
+    bench.write_probe_state(False, 150.0, writer="hw_watch")
+
+    on_acc, info = bench.probe_accelerator()
+    assert on_acc is False
+    assert info["probe_fast_path"] is True
+    assert info["probe_attempts"] == 1          # collapsed schedule
+    assert calls == [120.0]                     # BLUEFOG_BENCH_FAST_TIMEOUT
+    # the failure was re-recorded for the next run
+    assert json.load(open(bench.PROBE_STATE_FILE))["ok"] is False
+
+
+def test_bench_fast_path_ignores_full_schedule_attempts(paths, monkeypatch):
+    # an exported full-schedule PROBE_ATTEMPTS must not defeat the ~2-min
+    # fast-fallback guarantee (it has its own FAST_ATTEMPTS knob)
+    monkeypatch.setenv("BLUEFOG_BENCH_PROBE_ATTEMPTS", "3")
+    bench = _load_bench(monkeypatch, paths)
+    calls = []
+    monkeypatch.setattr(bench, "_probe",
+                        lambda env, timeout: calls.append(timeout) or False)
+    bench.write_probe_state(False, 150.0, writer="hw_watch")
+    _, info = bench.probe_accelerator()
+    assert info["probe_fast_path"] is True
+    assert info["probe_attempts"] == 1
+
+
+def test_bench_full_schedule_when_state_fresh_or_ok(paths, monkeypatch):
+    bench = _load_bench(monkeypatch, paths)
+    monkeypatch.setenv("BLUEFOG_BENCH_PROBE_SLEEP", "0")
+    calls = []
+    monkeypatch.setattr(bench, "_probe",
+                        lambda env, timeout: calls.append(timeout) or False)
+
+    # no state file at all → full schedule (3 x 240)
+    on_acc, info = bench.probe_accelerator()
+    assert info["probe_fast_path"] is False
+    assert info["probe_attempts"] == 3
+    assert calls == [240.0] * 3
+
+    # recent SUCCESS → also full schedule (a fresh probe is worth it)
+    calls.clear()
+    bench.write_probe_state(True, 5.0, writer="hw_watch")
+    on_acc, info = bench.probe_accelerator()
+    assert info["probe_fast_path"] is False
+    assert calls == [240.0] * 3
+
+    # stale failure (older than the memory window) → full schedule
+    calls.clear()
+    doc = {"ts": time.time() - 7200, "ok": False, "seconds": 150.0}
+    with open(bench.PROBE_STATE_FILE, "w") as f:
+        json.dump(doc, f)
+    on_acc, info = bench.probe_accelerator()
+    assert info["probe_fast_path"] is False
+    assert calls == [240.0] * 3

@@ -1,0 +1,311 @@
+"""Automated TPU-tunnel watcher: catch an uptime window, run the battery.
+
+Two rounds of manual probing caught zero tunnel uptime; this watcher turns
+the problem into automation.  A lockfile-guarded loop probes the accelerator
+(``import jax; jax.devices()`` in a subprocess — the axon plugin hangs on a
+dead tunnel, so the child is killed at the timeout) every ``--interval``
+seconds, appends every outcome to ``docs/measured/hw_watch_probes.log`` and
+to the shared probe-state file ``.probe_state.json`` (which bench.py reads
+to shorten its own probing after known-recent failures).  On the first
+successful probe it runs the full measurement battery unattended, in order:
+
+    bench.py                                 → docs/measured/bench_<tag>.json
+    tools/tpu_validate.py --out …            → tpu_validate_<tag>.json
+    tools/chip_calibrate.py                  → chip_calibrate_<tag>.json
+    tools/step_sweep.py --out … --trace …    → step_sweep_<tag>.json + trace
+    tools/lm_bench.py --out …                → lm_bench_<tag>.json   (if present)
+    tools/trace_analyze.py …                 → trace_split_<tag>.json (if present)
+    tools/perf_fill.py --tag <tag>           → PERFORMANCE.md headline (if present)
+
+then commits the artifact paths.  The battery list is resolved when the
+probe succeeds (not at watcher start), so tools added while the watcher is
+already running are picked up.  Single-client discipline: the watcher is
+the ONLY process that should dial the tunnel while it runs (the axon relay
+wedges under concurrent connections) — bench.py's fast-fallback path keeps
+the driver's own probing short while the watcher owns the tunnel.
+
+Run:        python tools/hw_watch.py            (foreground loop)
+            nohup python tools/hw_watch.py &    (detached, all round)
+Smoke test: python tools/hw_watch.py --once --stub-probe true --stub-battery
+"""
+from __future__ import annotations
+
+import argparse
+import datetime
+import fcntl
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, REPO)
+import bench as _bench  # noqa: E402 — single owner of probe + state logic
+
+# env overrides keep test runs out of the real artifact dir / lock files
+MEASURED = os.environ.get(
+    "BLUEFOG_MEASURED_DIR", os.path.join(REPO, "docs", "measured"))
+LOCKFILE = os.environ.get(
+    "BLUEFOG_HW_WATCH_LOCK", os.path.join(REPO, ".hw_watch.lock"))
+PROBE_LOG = os.path.join(MEASURED, "hw_watch_probes.log")
+
+
+def _utcnow() -> str:
+    return datetime.datetime.now(datetime.timezone.utc).strftime(
+        "%Y-%m-%dT%H:%M:%SZ")
+
+
+_lock_fd = None
+
+
+def acquire_lock() -> bool:
+    """Single-instance guard via flock: atomic, and released by the kernel
+    on process death, so there is no stale-pid takeover race.  The pid is
+    written into the file purely for human diagnosis."""
+    global _lock_fd
+    fd = os.open(LOCKFILE, os.O_CREAT | os.O_RDWR, 0o644)
+    try:
+        fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+    except OSError:
+        os.close(fd)
+        return False
+    os.ftruncate(fd, 0)
+    os.write(fd, str(os.getpid()).encode())
+    _lock_fd = fd
+    return True
+
+
+def release_lock() -> None:
+    global _lock_fd
+    if _lock_fd is not None:
+        try:
+            os.unlink(LOCKFILE)       # before releasing: a new starter must
+        except OSError:               # not lock the about-to-vanish inode
+            pass
+        try:
+            os.close(_lock_fd)
+        except OSError:
+            pass
+        _lock_fd = None
+
+
+def log_probe(ok: bool, seconds: float, note: str = "") -> None:
+    os.makedirs(MEASURED, exist_ok=True)
+    with open(PROBE_LOG, "a") as f:
+        f.write(f"{_utcnow()} ok={ok} dt={seconds:.1f}s{note}\n")
+
+
+def _probe_env() -> dict:
+    """Probe must dial the real accelerator: scrub CPU-forcing settings a
+    test shell may have exported (conftest's virtual-mesh env)."""
+    env = dict(os.environ)
+    if "cpu" in env.get("JAX_PLATFORMS", "").lower():
+        env.pop("JAX_PLATFORMS")
+    flags = env.get("XLA_FLAGS", "")
+    if "host_platform_device_count" in flags:
+        kept = [t for t in flags.split()
+                if "host_platform_device_count" not in t]
+        env["XLA_FLAGS"] = " ".join(kept)
+    return env
+
+
+def probe(timeout_s: float, stub: str | None) -> bool:
+    """One accelerator probe in a subprocess; True iff a non-CPU device
+    enumerates within the timeout.  Delegates to bench._probe so the probe
+    command and kill loop live in exactly one place."""
+    if stub is not None:
+        return subprocess.run(["/bin/sh", "-c", stub]).returncode == 0
+    return _bench._probe(_probe_env(), timeout_s)
+
+
+def _battery_steps(tag: str) -> list:
+    """(name, argv, timeout_s, stdout_capture_path|None), resolved at fire
+    time so tools added after watcher start are included."""
+    py = sys.executable
+    m = MEASURED
+    steps = [
+        ("bench", [py, os.path.join(REPO, "bench.py")], 3600,
+         os.path.join(m, f"bench_{tag}.json")),
+        ("tpu_validate",
+         [py, os.path.join(REPO, "tools", "tpu_validate.py"),
+          "--out", os.path.join(m, f"tpu_validate_{tag}.json")], 3600, None),
+        ("chip_calibrate",
+         [py, os.path.join(REPO, "tools", "chip_calibrate.py")], 2400,
+         os.path.join(m, f"chip_calibrate_{tag}.json")),
+        ("step_sweep",
+         [py, os.path.join(REPO, "tools", "step_sweep.py"),
+          "--out", os.path.join(m, f"step_sweep_{tag}.json"),
+          "--trace", os.path.join(m, f"trace_{tag}")], 5400, None),
+    ]
+    lm = os.path.join(REPO, "tools", "lm_bench.py")
+    if os.path.exists(lm):
+        steps.append(("lm_bench",
+                      [py, lm, "--out", os.path.join(m, f"lm_bench_{tag}.json")],
+                      3600, None))
+    ta = os.path.join(REPO, "tools", "trace_analyze.py")
+    if os.path.exists(ta):
+        steps.append(("trace_analyze",
+                      [py, ta, os.path.join(m, f"trace_{tag}"),
+                       "--out", os.path.join(m, f"trace_split_{tag}.json")],
+                      600, None))
+    pf = os.path.join(REPO, "tools", "perf_fill.py")
+    if os.path.exists(pf):
+        steps.append(("perf_fill", [py, pf, "--tag", tag], 600, None))
+    return steps
+
+
+def _bench_env() -> dict:
+    """The tunnel just answered a probe — bench need not re-probe slowly.
+    The watcher holds the tunnel lock for the whole battery, so children
+    must not try to take it themselves (flock is per-fd: a child blocking
+    on the parent's lock would deadlock until its wait budget expires)."""
+    env = _probe_env()
+    env["BLUEFOG_BENCH_TUNNEL_LOCK"] = "0"
+    env.setdefault("BLUEFOG_BENCH_PROBE_ATTEMPTS", "2")
+    env.setdefault("BLUEFOG_BENCH_PROBE_TIMEOUT", "240")
+    env.setdefault("BLUEFOG_BENCH_PROBE_SLEEP", "20")
+    return env
+
+
+def run_battery(tag: str, stub: bool, no_commit: bool) -> dict:
+    os.makedirs(MEASURED, exist_ok=True)
+    logdir = os.path.join(MEASURED, "logs")
+    os.makedirs(logdir, exist_ok=True)
+    results = {}
+    steps = ([("stub", [sys.executable, "-c", "print('{\"stub\": true}')"],
+               60, os.path.join(MEASURED, f"bench_{tag}.json"))]
+             if stub else _battery_steps(tag))
+    for name, argv, timeout_s, capture in steps:
+        t0 = time.monotonic()
+        log_path = os.path.join(logdir, f"{name}_{tag}.log")
+        print(f"hw_watch: battery step '{name}' starting "
+              f"(timeout {timeout_s}s, log {log_path})", flush=True)
+        try:
+            # start_new_session: a timed-out step is killed as a whole
+            # process GROUP — bench/validate/sweep spawn their own probe
+            # subprocesses, and an orphaned dialer hanging on the tunnel
+            # would recreate the concurrent-dial wedge the lock prevents
+            with open(log_path, "w") as logf:
+                p = subprocess.Popen(
+                    argv, env=_bench_env(), cwd=REPO, text=True,
+                    stdout=subprocess.PIPE, stderr=logf,
+                    start_new_session=True)
+                try:
+                    out, _ = p.communicate(timeout=timeout_s)
+                except subprocess.TimeoutExpired:
+                    try:
+                        os.killpg(p.pid, 9)
+                    except OSError:
+                        p.kill()
+                    p.wait()
+                    raise
+            out = out or ""
+            with open(log_path, "a") as logf:
+                logf.write("\n--- stdout ---\n" + out)
+            if capture:
+                # keep only the JSON payload: a line-per-record stream
+                # becomes an array, a single trailing object stays as-is
+                lines = [ln for ln in out.splitlines() if ln.strip()]
+                docs = []
+                for ln in lines:
+                    try:
+                        docs.append(json.loads(ln))
+                    except ValueError:
+                        pass
+                if docs:
+                    with open(capture, "w") as f:
+                        json.dump(docs[-1] if len(docs) == 1 else docs,
+                                  f, indent=1)
+            results[name] = {"rc": p.returncode,
+                             "seconds": round(time.monotonic() - t0, 1)}
+        except subprocess.TimeoutExpired:
+            results[name] = {"rc": "timeout",
+                             "seconds": round(time.monotonic() - t0, 1)}
+        except Exception as e:                      # noqa: BLE001
+            results[name] = {"rc": f"error: {e}"[:200],
+                             "seconds": round(time.monotonic() - t0, 1)}
+        print(f"hw_watch: battery step '{name}' -> {results[name]}",
+              flush=True)
+    summary = {"tag": tag, "utc": _utcnow(), "steps": results}
+    with open(os.path.join(MEASURED, f"battery_{tag}.json"), "w") as f:
+        json.dump(summary, f, indent=1)
+    if not no_commit:
+        _commit_artifacts(tag)
+    return summary
+
+
+def _commit_artifacts(tag: str) -> None:
+    """Commit only the artifact paths; never touches other staged work."""
+    paths = ["docs/measured", "PERFORMANCE.md", "docs/PERFORMANCE.md"]
+    existing = [p for p in paths if os.path.exists(os.path.join(REPO, p))]
+    try:
+        subprocess.run(["git", "add", "--"] + existing, cwd=REPO, check=True)
+        subprocess.run(
+            ["git", "commit", "-m",
+             f"hw-watch: on-TPU measurement battery ({tag})", "--"] + existing,
+            cwd=REPO, check=False)
+    except Exception as e:                          # noqa: BLE001
+        print(f"hw_watch: git commit failed: {e}", file=sys.stderr)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--interval", type=float, default=600.0,
+                    help="seconds between probes (default 600)")
+    ap.add_argument("--probe-timeout", type=float, default=150.0)
+    ap.add_argument("--max-batteries", type=int, default=1,
+                    help="stop firing the battery after this many successes "
+                         "(probing continues, keeping the state file fresh)")
+    ap.add_argument("--once", action="store_true",
+                    help="single probe (plus battery on success) then exit")
+    ap.add_argument("--tag", default=os.environ.get("BLUEFOG_ROUND", "r05"),
+                    help="artifact filename tag (default r05)")
+    ap.add_argument("--stub-probe", default=None, metavar="SHELL_CMD",
+                    help="testing: run this shell command as the probe")
+    ap.add_argument("--stub-battery", action="store_true",
+                    help="testing: replace the battery with a stub step")
+    ap.add_argument("--no-commit", action="store_true")
+    args = ap.parse_args()
+
+    if not acquire_lock():
+        print("hw_watch: another instance holds the lock; exiting",
+              file=sys.stderr)
+        return 3
+    batteries = 0
+    try:
+        while True:
+            t0 = time.monotonic()
+            # the tunnel lock covers both the probe and any battery it
+            # triggers: a driver-run bench.py holding the lock (it may be
+            # mid-measurement on the chip) must never see a concurrent dial
+            with _bench.tunnel_client_lock(wait_s=0.0) as held:
+                if not held:
+                    log_probe(False, 0.0, note=" skipped=tunnel-busy")
+                    print("hw_watch: tunnel held by another client; "
+                          "skipping this cycle", flush=True)
+                    if args.once:
+                        return 4
+                    time.sleep(args.interval)
+                    continue
+                ok = probe(args.probe_timeout, args.stub_probe)
+                dt = time.monotonic() - t0
+                _bench.write_probe_state(ok, dt, writer="hw_watch")
+                log_probe(ok, dt)
+                print(f"hw_watch: probe ok={ok} dt={dt:.1f}s", flush=True)
+                if ok and batteries < args.max_batteries:
+                    batteries += 1
+                    summary = run_battery(args.tag, args.stub_battery,
+                                          args.no_commit)
+                    log_probe(True, dt, note=f" battery={summary['steps']}")
+            if args.once:
+                return 0 if ok else 1
+            time.sleep(max(0.0, args.interval - dt))
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        release_lock()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,129 @@
+"""Tests for tools/trace_analyze.py (compute/comm/exposed-comm split) and
+tools/perf_fill.py (PERFORMANCE.md auto-fill) — the post-processing stages
+of the hw-watch battery.  The trace fixture is hand-written Chrome-trace
+JSON: deterministic intervals whose overlap arithmetic is checkable by
+hand, no profiler dependency."""
+import gzip
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+def _load(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _trace_doc():
+    """Device track: compute [0,100)+[150,250)ms, comm [80,130)+[200,220)ms.
+    comm total 70ms; exposed = [100,130) = 30ms; busy = [0,130)+[150,250);
+    wall 250ms; idle = [130,150) = 20ms.  (Trace units are microseconds.)"""
+    ms = 1000.0
+    ev = [
+        {"ph": "M", "name": "process_name", "pid": 1,
+         "args": {"name": "/device:TPU:0"}},
+        {"ph": "M", "name": "process_name", "pid": 2,
+         "args": {"name": "python host"}},
+        # device events
+        {"ph": "X", "pid": 1, "tid": 1, "name": "fusion.1",
+         "ts": 0, "dur": 100 * ms},
+        {"ph": "X", "pid": 1, "tid": 2, "name": "collective-permute.3",
+         "ts": 80 * ms, "dur": 50 * ms},
+        {"ph": "X", "pid": 1, "tid": 1, "name": "dot.7",
+         "ts": 150 * ms, "dur": 100 * ms},
+        {"ph": "X", "pid": 1, "tid": 2, "name": "all-reduce.9",
+         "ts": 200 * ms, "dur": 20 * ms},
+        # host noise that must be ignored (device pids exist)
+        {"ph": "X", "pid": 2, "tid": 1, "name": "python busywork",
+         "ts": 0, "dur": 500 * ms},
+    ]
+    return {"traceEvents": ev}
+
+
+def test_trace_analyze_overlap_arithmetic(tmp_path):
+    ta = _load("trace_analyze")
+    doc = ta.analyze(_trace_doc()["traceEvents"])
+    assert doc["ok"] is True
+    assert doc["n_events"] == 4                 # host track excluded
+    assert abs(doc["wall_ms"] - 250.0) < 1e-6
+    assert abs(doc["compute_ms"] - 200.0) < 1e-6
+    assert abs(doc["comm_ms"] - 70.0) < 1e-6
+    assert abs(doc["comm_exposed_ms"] - 30.0) < 1e-6
+    assert abs(doc["overlap_fraction"] - (1 - 30.0 / 70.0)) < 1e-3
+    assert abs(doc["idle_ms"] - 20.0) < 1e-6
+
+
+def test_trace_analyze_cli_on_gzipped_dir(tmp_path):
+    run = tmp_path / "plugins" / "profile" / "run1"
+    run.mkdir(parents=True)
+    with gzip.open(run / "host.trace.json.gz", "wt") as f:
+        json.dump(_trace_doc(), f)
+    out = tmp_path / "split.json"
+    p = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "trace_analyze.py"),
+         str(tmp_path), "--out", str(out)],
+        capture_output=True, text=True, timeout=60)
+    assert p.returncode == 0, p.stderr
+    doc = json.load(open(out))
+    assert doc["ok"] and doc["comm_exposed_ms"] == 30.0
+
+
+def test_trace_analyze_fallback_busiest_track():
+    ta = _load("trace_analyze")
+    # no process_name metadata at all -> the busiest pid wins (here the
+    # 500 ms host-noise track, proving the fallback keys on duration)
+    ev = [e for e in _trace_doc()["traceEvents"] if e["ph"] == "X"]
+    doc = ta.analyze(ev)
+    assert doc["ok"] and doc["n_events"] == 1
+    # with the noise gone, the remaining single-pid trace analyzes fully
+    doc = ta.analyze([e for e in ev if e["pid"] == 1])
+    assert doc["ok"] and doc["n_events"] == 4
+
+
+def test_perf_fill_renders_and_is_idempotent(tmp_path, monkeypatch):
+    measured = tmp_path / "measured"
+    measured.mkdir()
+    (measured / "bench_rX.json").write_text(json.dumps({
+        "ok": True, "value": 321.5, "unit": "img/s/chip", "mfu": 0.41,
+        "vs_baseline": 1.19, "on_accelerator": True, "device": "TPU v5e"}))
+    (measured / "trace_split_rX.json").write_text(json.dumps({
+        "ok": True, "busy_ms": 1, "wall_ms": 2, "idle_ms": 1,
+        "compute_ms": 0.8, "comm_ms": 0.4, "comm_exposed_ms": 0.1,
+        "overlap_fraction": 0.75}))
+    monkeypatch.setenv("BLUEFOG_MEASURED_DIR", str(measured))
+    pf = _load("perf_fill")
+
+    filled = pf.fill("rX", dry_run=True)
+    assert "321.5 img/s/chip" in filled
+    assert "41.0%" in filled                      # MFU formatted
+    assert "overlap fraction 0.75" in filled
+    assert filled.count(pf.BEGIN) == 1
+    # idempotent: writing again replaces the marked block, not appends
+    open_orig = pf.PERF
+    try:
+        perf_copy = tmp_path / "PERFORMANCE.md"
+        perf_copy.write_text(open(open_orig).read())
+        pf.PERF = str(perf_copy)
+        pf.fill("rX")
+        once = perf_copy.read_text()
+        pf.fill("rX")
+        twice = perf_copy.read_text()
+        assert once.count(pf.BEGIN) == 1
+        assert twice.count(pf.BEGIN) == 1
+        assert "321.5" in once
+        # truncated-block recovery: BEGIN without END (kill mid-write)
+        # must not duplicate the block on the next fill
+        perf_copy.write_text(once[:once.index(pf.END)])
+        pf.fill("rX")
+        healed = perf_copy.read_text()
+        assert healed.count(pf.BEGIN) == 1
+        assert healed.count(pf.END) == 1
+    finally:
+        pf.PERF = open_orig

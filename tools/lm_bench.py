@@ -1,0 +1,226 @@
+"""Transformer-LM benchmark: tokens/sec + MFU for the ring-SP/Pallas path.
+
+The repo's beyond-reference surface (ring attention, zigzag layout, Pallas
+flash kernels — SURVEY.md §5 long-context) gets its own measured number
+beside the ResNet headline (bench.py).  A GPT-style ``RingTransformerLM``
+trains on synthetic tokens with Adam; the measurement is the steady-state
+training step, ``lax.scan``-batched ``--steps-per-call`` deep so one
+host->device dispatch covers several optimizer steps (the tunnel's
+dispatch latency otherwise dominates, see tools/chip_calibrate.py).
+
+On the single axon chip the ring is degenerate (n=1) but the Pallas
+flash-attention kernel compiles through Mosaic and does the real work —
+that is the number the battery wants.  On a pod slice the sequence shards
+across the mesh and the same script measures true ring-SP throughput.
+
+MFU uses the standard analytic convention (PaLM appendix-B shape):
+``train FLOPs/token = 6·N_params + 6·L·d_model·T`` (the attention term
+halved for causal masking); XLA's cost-analysis count is reported
+alongside as ``xla_call_flops``.
+
+Run:    python tools/lm_bench.py --out docs/measured/lm_bench_r05.json
+Smoke:  python tools/lm_bench.py --virtual-cpu --smoke
+"""
+import argparse
+import importlib.util
+import json
+import os
+import sys
+import time
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, REPO)
+
+
+def _load_bench():
+    spec = importlib.util.spec_from_file_location(
+        "bench_mod", os.path.join(REPO, "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--virtual-cpu", action="store_true",
+                    help="8-device virtual CPU mesh (smoke/testing)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny shapes for CI (implies quick compile)")
+    ap.add_argument("--seq", type=int, default=None,
+                    help="global sequence length (default 4096; smoke 256)")
+    ap.add_argument("--layers", type=int, default=None)
+    ap.add_argument("--d-model", type=int, default=None)
+    ap.add_argument("--heads", type=int, default=None)
+    ap.add_argument("--batch", type=int, default=None)
+    ap.add_argument("--vocab", type=int, default=None)
+    ap.add_argument("--iters", type=int, default=None)
+    ap.add_argument("--steps-per-call", type=int, default=None)
+    ap.add_argument("--sp-layout", default="zigzag",
+                    choices=["contiguous", "zigzag"],
+                    help="ring layout when the mesh has >1 device")
+    ap.add_argument("--no-pallas", action="store_true",
+                    help="pure-XLA attention instead of the flash kernel")
+    ap.add_argument("--out", default=None, help="json artifact path")
+    ap.add_argument("--allow-cpu", action="store_true")
+    args = ap.parse_args()
+
+    smoke = args.smoke or args.virtual_cpu
+    seq = args.seq or (256 if smoke else 4096)
+    layers = args.layers or (2 if smoke else 12)
+    d_model = args.d_model or (64 if smoke else 1024)
+    heads = args.heads or (2 if smoke else 16)
+    batch = args.batch or (1 if smoke else 4)
+    vocab = args.vocab or (64 if smoke else 32768)
+    iters = args.iters or (2 if smoke else 5)
+    steps_per_call = args.steps_per_call or (1 if smoke else 4)
+
+    if args.virtual_cpu:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+    if args.virtual_cpu:
+        jax.config.update("jax_platforms", "cpu")
+
+    from bluefog_tpu.utils.config import enable_compilation_cache
+    enable_compilation_cache()
+
+    dev = jax.devices()[0]
+    if dev.platform == "cpu" and not (args.virtual_cpu or args.allow_cpu):
+        print("refusing: no accelerator (pass --virtual-cpu or --allow-cpu)",
+              file=sys.stderr)
+        sys.exit(2)
+
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+    import bluefog_tpu as bf
+    from bluefog_tpu import models
+
+    bf.init(platform="cpu" if args.virtual_cpu else None)
+    n = bf.size()
+    if seq % n:
+        raise SystemExit(
+            f"--seq ({seq}) must be a multiple of the device count ({n})")
+    local_T = seq // n
+    on_tpu = jax.default_backend() == "tpu"
+    use_pallas = (not args.no_pallas) and on_tpu
+    layout = args.sp_layout if n > 1 else "contiguous"
+    if layout == "zigzag" and local_T % 2:
+        layout = "contiguous"
+
+    lm = models.RingTransformerLM(
+        vocab_size=vocab, num_layers=layers, num_heads=heads,
+        d_model=d_model, max_seq_len=seq, axis="rank" if n > 1 else None,
+        dtype=jnp.bfloat16, sp_mode="ring", sp_layout=layout, rope=True,
+        use_pallas=use_pallas)
+    params = lm.clone(axis=None).init(
+        jax.random.key(0), jnp.zeros((1, local_T), jnp.int32))
+    n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
+
+    opt = optax.adamw(3e-4)
+    opt_state = opt.init(params)
+
+    def one_step(params, opt_state, tokens, targets):
+        if n > 1:
+            idx = lax.axis_index("rank")
+            positions = (bf.ops.zigzag_positions(idx, n, local_T // 2)
+                         if layout == "zigzag" else
+                         idx * local_T + jnp.arange(local_T))
+        else:
+            positions = jnp.arange(local_T)
+
+        def loss_fn(p):
+            logits = lm.apply(p, tokens, positions=positions)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, targets).mean()
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        if n > 1:
+            grads = jax.tree.map(lambda g: lax.psum(g, "rank"), grads)
+            loss = lax.pmean(loss, "rank")
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    def k_steps(params, opt_state, tokens, targets):
+        def body(carry, _):
+            p, s = carry
+            p, s, loss = one_step(p, s, tokens, targets)
+            return (p, s), loss
+        (params, opt_state), losses = lax.scan(
+            body, (params, opt_state), None, length=steps_per_call)
+        return params, opt_state, losses[-1]
+
+    if n > 1:
+        step = jax.jit(jax.shard_map(
+            k_steps, mesh=bf.mesh(),
+            in_specs=(P(), P(), P(None, "rank"), P(None, "rank")),
+            out_specs=(P(), P(), P())))
+    else:
+        step = jax.jit(k_steps)
+
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(0, vocab, (batch, seq)), jnp.int32)
+    targets = jnp.roll(tokens, -1, axis=1)
+
+    xla_call_flops = None
+    try:
+        compiled = step.lower(params, opt_state, tokens, targets).compile()
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0]
+        f = float(ca.get("flops", 0.0))
+        if f > 0:
+            xla_call_flops = f
+        step = compiled
+    except Exception:
+        pass                                # fall back to the jit path
+
+    params, opt_state, loss = step(params, opt_state, tokens, targets)
+    bf.hard_sync(loss)                      # compile + warm
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        params, opt_state, loss = step(params, opt_state, tokens, targets)
+    bf.hard_sync(loss)
+    dt = time.perf_counter() - t0
+
+    tokens_per_step = batch * seq
+    total_tokens = iters * steps_per_call * tokens_per_step
+    tok_per_sec = total_tokens / dt
+    # analytic train FLOPs/token (see module docstring for the convention)
+    flops_per_token = 6 * n_params + 6 * layers * d_model * seq
+    bench = _load_bench()
+    peak = bench._peak_flops(dev.device_kind) if on_tpu else None
+    mfu = (tok_per_sec * flops_per_token / (peak * n)) if peak else None
+
+    doc = {
+        "metric": "transformer_lm_tokens_per_sec",
+        "value": round(tok_per_sec, 1),
+        "unit": "tok/s",
+        "ok": True,
+        "on_accelerator": on_tpu,
+        "device": dev.device_kind,
+        "n_chips": n,
+        "mfu": round(mfu, 4) if mfu is not None else None,
+        "config": {"seq": seq, "layers": layers, "d_model": d_model,
+                   "heads": heads, "batch": batch, "vocab": vocab,
+                   "n_params": n_params, "sp_layout": layout,
+                   "use_pallas": use_pallas,
+                   "steps_per_call": steps_per_call, "iters": iters},
+        "flops_per_token": flops_per_token,
+        "xla_call_flops": xla_call_flops,
+        "final_loss": float(loss),
+    }
+    print(json.dumps(doc))
+    if args.out:
+        os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+        with open(args.out, "w") as f:
+            json.dump(doc, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,157 @@
+"""Fill docs/PERFORMANCE.md's measured-headline section from battery
+artifacts (the final step of the hw-watch battery, tools/hw_watch.py).
+
+Reads ``docs/measured/{bench,lm_bench,chip_calibrate,step_sweep,
+trace_split,tpu_validate}_<tag>.json`` (whichever exist) and rewrites the
+block between the ``HW-WATCH:BEGIN``/``HW-WATCH:END`` markers in
+docs/PERFORMANCE.md — inserting the marked block after the title on first
+run.  Tolerant of missing artifacts: rows only appear for data that
+landed, so a partially-successful battery still publishes what it got.
+
+Run: python tools/perf_fill.py --tag r05 [--dry-run]
+"""
+import argparse
+import datetime
+import json
+import os
+import sys
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+PERF = os.path.join(REPO, "docs", "PERFORMANCE.md")
+MEASURED = os.environ.get(
+    "BLUEFOG_MEASURED_DIR", os.path.join(REPO, "docs", "measured"))
+BEGIN = "<!-- HW-WATCH:BEGIN (auto-filled by tools/perf_fill.py) -->"
+END = "<!-- HW-WATCH:END -->"
+
+
+def _load(name, tag):
+    path = os.path.join(MEASURED, f"{name}_{tag}.json")
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def _fmt_mfu(mfu):
+    return f"{mfu:.1%}" if isinstance(mfu, (int, float)) else "n/a"
+
+
+def render(tag):
+    now = datetime.datetime.now(datetime.timezone.utc).strftime("%Y-%m-%d")
+    lines = [BEGIN,
+             f"## Measured on hardware ({tag}, auto-filled {now} by the "
+             "hw-watch battery)", ""]
+    bench = _load("bench", tag)
+    lm = _load("lm_bench", tag)
+    rows = []
+    if bench and bench.get("ok"):
+        dev = bench.get("device", "?")
+        acc = "TPU" if bench.get("on_accelerator") else "CPU FALLBACK"
+        rows.append(
+            f"| ResNet-50 synthetic ({acc} {dev}) | "
+            f"**{bench.get('value')} {bench.get('unit', '')}** | "
+            f"MFU {_fmt_mfu(bench.get('mfu'))} | "
+            f"vs V100 baseline x{bench.get('vs_baseline')} |")
+    if lm and lm.get("ok"):
+        cfg = lm.get("config", {})
+        acc = "TPU" if lm.get("on_accelerator") else "CPU FALLBACK"
+        rows.append(
+            f"| Transformer LM ring-SP ({acc}, L{cfg.get('layers')} "
+            f"d{cfg.get('d_model')} T{cfg.get('seq')}) | "
+            f"**{lm.get('value')} tok/s** | MFU {_fmt_mfu(lm.get('mfu'))} | "
+            f"pallas={cfg.get('use_pallas')} |")
+    if rows:
+        lines += ["| benchmark | throughput | MFU | note |",
+                  "|---|---|---|---|", *rows, ""]
+
+    cal = _load("chip_calibrate", tag)
+    if cal:
+        entries = cal if isinstance(cal, list) else [cal]
+        probes = [e for e in entries if isinstance(e, dict) and "probe" in e]
+        if probes:
+            lines += ["Chip ceilings (`tools/chip_calibrate.py`, scanned "
+                      "one-dispatch loops):", ""]
+            for e in probes:
+                if e["probe"] == "device":
+                    continue
+                extra = (f"{e['tflops']} TFLOP/s" if "tflops" in e
+                         else f"{e.get('gbps')} GB/s")
+                lines.append(
+                    f"- `{e['probe']}`: {extra}, dispatch overhead "
+                    f"{e.get('dispatch_overhead_ms', '?')} ms")
+            lines.append("")
+
+    sweep = _load("step_sweep", tag)
+    if sweep and isinstance(sweep, dict) and sweep.get("rows"):
+        lines += [f"`steps_per_call` amortization (`tools/step_sweep.py`, "
+                  f"batch {sweep.get('batch')}, best "
+                  f"x{sweep.get('dispatch_amortization')}):", ""]
+        for p in sweep["rows"]:
+            lines.append(f"- k={p['steps_per_call']}: "
+                         f"{p.get('imgs_per_sec_per_chip')} img/s/chip "
+                         f"(x{p.get('vs_spc1')} vs k=1, "
+                         f"MFU {_fmt_mfu(p.get('mfu'))})")
+        lines.append("")
+
+    split = _load("trace_split", tag)
+    if split and split.get("ok"):
+        lines += [
+            "Step-time decomposition (`tools/trace_analyze.py` on the "
+            "step_sweep trace):", "",
+            f"- device busy {split.get('busy_ms')} ms of "
+            f"{split.get('wall_ms')} ms wall "
+            f"(idle/dispatch {split.get('idle_ms')} ms)",
+            f"- compute {split.get('compute_ms')} ms, comm "
+            f"{split.get('comm_ms')} ms of which EXPOSED only "
+            f"{split.get('comm_exposed_ms')} ms "
+            f"(overlap fraction {split.get('overlap_fraction')})", ""]
+
+    val = _load("tpu_validate", tag)
+    if val:
+        lines += [f"Kernel validation (`tools/tpu_validate.py`): "
+                  f"**{val.get('summary', '?')}** over "
+                  f"{val.get('n_checks', '?')} checks on "
+                  f"{val.get('device', '?')}.", ""]
+
+    if len(lines) <= 3:
+        lines += ["_(battery produced no artifacts for this tag)_", ""]
+    lines.append(END)
+    return "\n".join(lines)
+
+
+def fill(tag, dry_run=False):
+    block = render(tag)
+    with open(PERF) as f:
+        text = f.read()
+    if BEGIN in text:
+        pre = text[:text.index(BEGIN)]
+        if END in text:
+            post = text[text.index(END) + len(END):]
+        else:
+            # BEGIN without END = a kill mid-write truncated the block;
+            # everything after BEGIN is the partial block — drop it
+            post = "\n"
+        new = pre + block + post
+    else:
+        # first run: insert the marked block right after the title line
+        head, _, rest = text.partition("\n")
+        new = head + "\n\n" + block + "\n" + rest
+    if not dry_run:
+        with open(PERF, "w") as f:
+            f.write(new)
+    return new
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--tag", default=os.environ.get("BLUEFOG_ROUND", "r05"))
+    ap.add_argument("--dry-run", action="store_true")
+    args = ap.parse_args()
+    fill(args.tag, args.dry_run)
+    print(json.dumps({"ok": True, "tag": args.tag,
+                      "performance_md": PERF}))
+
+
+if __name__ == "__main__":
+    main()

@@ -815,55 +815,66 @@ def _zero_apply(opt, grads, opt_state, params, axis: Axis, n: int):
 
 def _check_elementwise_chain(opt: optax.GradientTransformation,
                              n_probe: int = 2) -> None:
-    """Tripwire for the ZeRO elementwise requirement (see
+    """Best-effort tripwire for the ZeRO elementwise requirement (see
     :func:`zero_gradient_allreduce`): run ``opt.update`` once on a small
     structured dummy tree (reference semantics) and once on emulated ZeRO
     shard buffers (pad + split each fused dtype bucket across ``n_probe``
     virtual ranks, one state shard each — exactly ``_zero_apply``'s
     dataflow), and raise if the resulting parameters differ.
 
-    Catches the silent divergence of tree-coupled chains:
-    ``clip_by_global_norm`` computes a *per-shard* norm under ZeRO (each
-    rank only holds 1/n of the elements), ``masked``/``multi_transform``
-    see flat buffers instead of the labeled tree (usually a structure
-    error), per-leaf scalers (e.g. trust-ratio) see shard norms.  Plain
-    sgd/momentum/adam/adamw chains are elementwise and pass bit-for-bit.
+    The probe runs at three gradient magnitudes (x1, x100, x0.01) so
+    threshold-dependent couplings fire on at least one of them — e.g.
+    ``clip_by_global_norm`` with a max_norm above the base probe's ~2.31
+    global norm takes its no-op branch at x1 but clips (per-shard vs
+    global norm, divergent) at x100.  Also catches ``masked``/
+    ``multi_transform`` (flat buffers instead of the labeled tree, usually
+    a structure error) and per-leaf scalers (trust ratios see shard
+    norms).  Plain sgd/momentum/adam/adamw chains are elementwise and pass
+    bit-for-bit.  Best-effort by construction: a coupling whose threshold
+    sits outside all three probe magnitudes (or that only engages on
+    shapes/dtypes unlike the probe tree) can still slip through — the
+    probe is a cheap guard, not a proof of elementwiseness.
     """
     tree_p = {"a": jnp.asarray([0.3, -0.4, 0.5], jnp.float32),
               "b": jnp.asarray([[2.0, -1.0], [0.5, 3.0]], jnp.float32)}
-    tree_g = {"a": jnp.asarray([0.1, 0.2, -0.3], jnp.float32),
+    base_g = {"a": jnp.asarray([0.1, 0.2, -0.3], jnp.float32),
               "b": jnp.asarray([[-1.0, 0.4], [0.2, 2.0]], jnp.float32)}
     why = None
     try:
-        ref_upd, _ = opt.update(tree_g, opt.init(tree_p), tree_p)
-        ref_new = optax.apply_updates(tree_p, ref_upd)
+        for scale in (1.0, 100.0, 0.01):
+            tree_g = jax.tree.map(lambda g: g * scale, base_g)
+            ref_upd, _ = opt.update(tree_g, opt.init(tree_p), tree_p)
+            ref_new = optax.apply_updates(tree_p, ref_upd)
 
-        fp, fg = fusion.fuse_tree(tree_p), fusion.fuse_tree(tree_g)
-        pads = [(-buf.size) % n_probe for buf in fp.buffers]
-        p_pad = [jnp.pad(b, (0, p)) for b, p in zip(fp.buffers, pads)]
-        g_pad = [jnp.pad(b, (0, p)) for b, p in zip(fg.buffers, pads)]
-        shards_new = []
-        for i in range(n_probe):
-            sl = lambda b: lax.dynamic_slice_in_dim(
-                b, i * (b.size // n_probe), b.size // n_probe)
-            p_sh = [sl(b) for b in p_pad]
-            g_sh = [sl(b) for b in g_pad]
-            st = opt.init([jnp.zeros_like(b) for b in p_sh])
-            upd, _ = opt.update(g_sh, st, p_sh)
-            shards_new.append(optax.apply_updates(p_sh, upd))
-        new_bufs = [
-            jnp.concatenate([shards_new[i][k] for i in range(n_probe)])
-            for k in range(len(p_pad))]
-        fp.buffers = [b[:b.size - p] if p else b
-                      for b, p in zip(new_bufs, pads)]
-        zero_new = fp.unfuse()
-        agree = all(
-            np.allclose(np.asarray(a), np.asarray(b), rtol=2e-5, atol=1e-6)
-            for a, b in zip(jax.tree.leaves(ref_new),
-                            jax.tree.leaves(zero_new)))
-        if not agree:
-            why = ("probe trajectories differ between the structured tree "
-                   "and ZeRO shard buffers")
+            fp, fg = fusion.fuse_tree(tree_p), fusion.fuse_tree(tree_g)
+            pads = [(-buf.size) % n_probe for buf in fp.buffers]
+            p_pad = [jnp.pad(b, (0, p)) for b, p in zip(fp.buffers, pads)]
+            g_pad = [jnp.pad(b, (0, p)) for b, p in zip(fg.buffers, pads)]
+            shards_new = []
+            for i in range(n_probe):
+                sl = lambda b: lax.dynamic_slice_in_dim(
+                    b, i * (b.size // n_probe), b.size // n_probe)
+                p_sh = [sl(b) for b in p_pad]
+                g_sh = [sl(b) for b in g_pad]
+                st = opt.init([jnp.zeros_like(b) for b in p_sh])
+                upd, _ = opt.update(g_sh, st, p_sh)
+                shards_new.append(optax.apply_updates(p_sh, upd))
+            new_bufs = [
+                jnp.concatenate([shards_new[i][k] for i in range(n_probe)])
+                for k in range(len(p_pad))]
+            fp.buffers = [b[:b.size - p] if p else b
+                          for b, p in zip(new_bufs, pads)]
+            zero_new = fp.unfuse()
+            agree = all(
+                np.allclose(np.asarray(a), np.asarray(b),
+                            rtol=2e-5, atol=1e-6)
+                for a, b in zip(jax.tree.leaves(ref_new),
+                                jax.tree.leaves(zero_new)))
+            if not agree:
+                why = ("probe trajectories differ between the structured "
+                       "tree and ZeRO shard buffers "
+                       f"(at gradient scale x{scale:g})")
+                break
     except Exception as exc:                    # structure errors etc.
         why = f"probe failed on ZeRO shard buffers: {exc!r}"
     if why:

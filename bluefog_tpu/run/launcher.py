@@ -79,6 +79,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "substitute a local stub)")
     p.add_argument("--coordinator", default=None,
                    help="coordinator address host:port for jax.distributed")
+    p.add_argument("--coordinator-port", type=int, default=48292,
+                   help="port for the derived default coordinator (first "
+                        "-H host); avoids collisions when two launches "
+                        "share a first host (ignored with --coordinator)")
     p.add_argument("--num-processes", type=int, default=None,
                    help="total process count for jax.distributed")
     p.add_argument("--process-id", type=int, default=None,
@@ -197,7 +201,7 @@ _FORWARD_PREFIXES = ("BLUEFOG_", "JAX_", "XLA_", "TPU_", "LIBTPU_")
 
 def build_multihost_plan(hosts, command, *, cwd, coordinator=None,
                          base_env=None, extra_env=(), remote_shell="ssh",
-                         ssh_port=None):
+                         ssh_port=None, coordinator_port=48292):
     """Build one remote-spawn argv per rank for the ``-H`` fan-out.
 
     Each rank's remote command cds into the launch directory and execs the
@@ -209,7 +213,11 @@ def build_multihost_plan(hosts, command, *, cwd, coordinator=None,
     """
     base_env = dict(base_env or {})
     total = sum(s for _, s in hosts)
-    coordinator = coordinator or f"{hosts[0][0]}:48292"
+    if coordinator is None:
+        # the first HOST is the coordinator: an ssh spec may carry a
+        # 'user@' login prefix, which is not part of the dialable address
+        host0 = hosts[0][0].rpartition("@")[2]
+        coordinator = f"{host0}:{coordinator_port}"
     forwarded = {k: v for k, v in base_env.items()
                  if k.startswith(_FORWARD_PREFIXES)
                  and k not in ("BLUEFOG_COORDINATOR", "BLUEFOG_PROCESS_ID",
@@ -254,7 +262,8 @@ def _multihost_fanout(args, env) -> int:
     plans = build_multihost_plan(
         hosts, args.command, cwd=os.getcwd(),
         coordinator=args.coordinator, base_env=env, extra_env=args.env,
-        remote_shell=args.remote_shell, ssh_port=args.ssh_port)
+        remote_shell=args.remote_shell, ssh_port=args.ssh_port,
+        coordinator_port=args.coordinator_port)
     procs = []
     for host, pid, argv in plans:
         print(f"bfrun-tpu: starting rank {pid} on {host}", flush=True)
@@ -326,7 +335,8 @@ def _interactive_cluster(args, env) -> int:
         plans = build_multihost_plan(
             hosts, worker_cmd, cwd=os.getcwd(),
             coordinator=args.coordinator, base_env=env, extra_env=args.env,
-            remote_shell=args.remote_shell, ssh_port=args.ssh_port)
+            remote_shell=args.remote_shell, ssh_port=args.ssh_port,
+            coordinator_port=args.coordinator_port)
         for host_, pid, argv in plans:
             # prefix the remote command with a token read from stdin
             argv = argv[:-1] + [
@@ -335,8 +345,11 @@ def _interactive_cluster(args, env) -> int:
             print(f"bfrun-tpu: starting interactive worker {pid} on "
                   f"{host_}", flush=True)
             p = subprocess.Popen(argv, stdin=subprocess.PIPE)
-            p.stdin.write((ctrl.token + "\n").encode())
-            p.stdin.close()
+            try:
+                p.stdin.write((ctrl.token + "\n").encode())
+                p.stdin.close()
+            except (BrokenPipeError, OSError):
+                pass              # spawn already dead; the monitor reports it
             procs.append(p)
         # a dead spawn (bad host, auth failure, missing interpreter) must
         # surface immediately, not as a silent 300 s accept timeout
@@ -345,9 +358,13 @@ def _interactive_cluster(args, env) -> int:
         ready = _threading.Event()
 
         def _monitor():
+            # ANY exit before the session is ready is fatal, exit code
+            # included: a worker that ends cleanly (ssh succeeded but the
+            # command no-op'd) has still not connected, and waiting out
+            # the full accept timeout would hide the diagnosis
             while not ready.is_set():
                 for p_ in procs:
-                    if p_.poll() not in (None, 0):
+                    if p_.poll() is not None:
                         print(f"bfrun-tpu: an interactive worker exited "
                               f"with code {p_.returncode} before "
                               "connecting — check host/interpreter "

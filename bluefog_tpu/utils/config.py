@@ -71,6 +71,15 @@ def enable_compilation_cache(path: Optional[str] = None) -> Optional[str]:
     load in milliseconds.  Default location is ``BLUEFOG_COMPILE_CACHE``
     (set to ``0``/``off`` to disable) or ``~/.cache/bluefog_tpu_xla``.
     Returns the cache dir, or None when disabled/unavailable.
+
+    No-ops when the process is pinned to the CPU backend: XLA:CPU cannot
+    deserialize cached executables (``DeserializeLoadedExecutable not
+    implemented`` warnings on every entry, and cross-machine AOT results
+    log feature-mismatch errors), so caching there is pure noise.  The
+    check reads the ``jax_platforms`` config STRING — it must not touch
+    ``jax.devices()``/``default_backend()``, which would initialize the
+    backend (and dial the TPU tunnel) as a side effect.  Callers should
+    invoke this AFTER their platform decision.
     """
     env = os.environ.get("BLUEFOG_COMPILE_CACHE", "").strip()
     if env.lower() in ("0", "off", "false", "none", "no", "disable"):
@@ -80,12 +89,19 @@ def enable_compilation_cache(path: Optional[str] = None) -> Optional[str]:
     try:
         import jax
 
+        platforms = (jax.config.jax_platforms or "").strip()
+        if platforms.split(",")[0].strip() == "cpu":
+            return None                    # CPU-pinned: see docstring
         os.makedirs(path, exist_ok=True)
         # cache everything that took a meaningful compile (the default 1 s
         # floor would skip small collective programs that still cost real
-        # dispatch-path latency to rebuild).  The dir is set LAST so a
-        # partial failure cannot leave caching active while we report None.
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)
+        # dispatch-path latency to rebuild) — but only lower the floor when
+        # it is still at JAX's default; a user-configured value wins.
+        if jax.config.jax_persistent_cache_min_compile_time_secs == 1.0:
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 0.2)
+        # The dir is set LAST so a partial failure cannot leave caching
+        # active while we report None.
         jax.config.update("jax_compilation_cache_dir", path)
         return path
     except Exception:                      # old jax / read-only filesystem

@@ -327,3 +327,25 @@ def test_remote_interactive_dead_spawn_fails_fast(tmp_path):
     assert "exited with code 7" in r.stderr, r.stderr[-1500:]
     assert "failed to connect" in r.stderr, r.stderr[-1500:]
     assert time.perf_counter() - t0 < 60
+
+
+def test_remote_interactive_clean_exit_spawn_fails_fast(tmp_path):
+    """A worker that exits 0 WITHOUT connecting (ssh fine, command no-ops)
+    is just as dead as a crash — it must abort the accept wait, not leave
+    the controller blocked for the full timeout (round-4 advisor item)."""
+    import time
+    stub = tmp_path / "fake_ssh"
+    stub.write_text("#!/bin/sh\nexit 0\n")
+    stub.chmod(0o755)
+    env = dict(os.environ)
+    env.pop("BLUEFOG_SESSION_TOKEN", None)
+    t0 = time.perf_counter()
+    r = subprocess.run(
+        [sys.executable, "-m", "bluefog_tpu.run.launcher",
+         "--interactive", "-H", "deadhost", "--remote-shell", str(stub)],
+        input="", env=env, cwd=REPO, capture_output=True, text=True,
+        timeout=120)
+    assert r.returncode != 0
+    assert "exited with code 0" in r.stderr, r.stderr[-1500:]
+    assert "failed to connect" in r.stderr, r.stderr[-1500:]
+    assert time.perf_counter() - t0 < 60

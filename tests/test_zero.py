@@ -155,6 +155,15 @@ def test_zero_rejects_tree_coupled_chains():
     assert strat.axes == ("rank",)
 
 
+def test_zero_rejects_high_threshold_clip():
+    """A max_norm ABOVE the base probe's ~2.31 global norm takes the no-op
+    branch at probe scale x1 — the x100 magnitude sweep must still catch
+    the coupling (round-4 advisor item: the point-probe let these pass)."""
+    lazy_clip = optax.chain(optax.clip_by_global_norm(10.0), optax.sgd(0.05))
+    with pytest.raises(ValueError, match="not elementwise"):
+        bfopt.zero_gradient_allreduce(lazy_clip)
+
+
 def test_zero_tripwire_passes_elementwise_chains():
     """sgd/momentum/adam/adamw construct cleanly (and the equivalence test
     above keeps pinning that they are exact under sharding)."""

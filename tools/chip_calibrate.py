@@ -32,8 +32,6 @@ sys.path.insert(0, ".")
 from bluefog_tpu.api import hard_sync  # noqa: E402
 from bluefog_tpu.utils.config import enable_compilation_cache  # noqa: E402
 
-enable_compilation_cache()
-
 
 def _timed(f, x):
     """Seconds for one dispatch of compiled ``f`` (hard_sync barrier)."""
@@ -68,6 +66,7 @@ def main():
         # the axon plugin force-sets jax_platforms at interpreter boot,
         # overriding the env var — without this a CI smoke dials the tunnel
         jax.config.update("jax_platforms", "cpu")
+    enable_compilation_cache()      # after the platform pin: no-op on CPU
     d = jax.devices()[0]
     print(json.dumps({"probe": "device", "kind": d.device_kind,
                       "platform": d.platform}))

@@ -36,11 +36,11 @@ def main():
     import jax
 
     from bluefog_tpu.utils.config import enable_compilation_cache
-    enable_compilation_cache()
     if args.allow_cpu:
         # the axon plugin force-sets jax_platforms at boot; without this a
         # CPU smoke dials the TPU tunnel
         jax.config.update("jax_platforms", "cpu")
+    enable_compilation_cache()      # after the platform pin: no-op on CPU
     dev = jax.devices()[0]
     if dev.platform == "cpu" and not args.allow_cpu:
         print("refusing: no accelerator (pass --allow-cpu to force)",

@@ -1,0 +1,153 @@
+"""Randomized END-TO-END gossip oracle (round-5 verdict item #4).
+
+``test_schedule.py`` fuzzes coloring *properties* on random digraphs and
+``test_ops.py`` checks execution against the dense-W oracle on *named*
+topologies; this module closes the gap between them: compile a random
+irregular digraph, run the actual collective on the mesh, and compare the
+result to ``W^T x`` in float64.  The composition under test — irregular
+in-degrees + partial permutation rounds + ppermute zero-fill + per-round
+weight tables — is exactly where a subtle schedule-compiler bug would
+hide.  Spec: the combine semantics of reference
+``torch/mpi_ops.cc:99-164`` for arbitrary graphs.
+
+Covers: unweighted (uniform 1/(in+1)) and weighted (random column-
+stochastic W) topologies at n = 2..8; the FUSED pytree path (one flat
+buffer per dtype, the optimizer strategies' dataflow); a wire-codec
+(bf16) case; and explicit dst-weighting (sender-side per-edge scaling).
+"""
+import networkx as nx
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import bluefog_tpu as bf
+from bluefog_tpu import optimizers as bfopt
+from bluefog_tpu import topology as tu
+
+DIM = 5
+
+
+def random_digraph(rng, n, density, weighted):
+    """Random irregular digraph with self-loops; weighted variants get
+    random column-stochastic mixing weights (each rank's receive weights
+    sum to 1, the gossip-averaging convention of the named generators)."""
+    topo = nx.DiGraph()
+    topo.add_nodes_from(range(n))
+    for i in range(n):
+        topo.add_edge(i, i)
+    for s in range(n):
+        for d in range(n):
+            if s != d and rng.random() < density:
+                topo.add_edge(s, d)
+    if weighted:
+        for d in range(n):
+            srcs = sorted(topo.predecessors(d))
+            w = rng.random(len(srcs)) + 0.1
+            w = w / w.sum()
+            for s, wi in zip(srcs, w):
+                topo[s][d]["weight"] = float(wi)
+    return topo
+
+
+def oracle(topo, weighted, vals):
+    """float64 dense-matrix reference: result[i] = sum_j W[j, i] vals[j]."""
+    n = topo.number_of_nodes()
+    if weighted:
+        W = tu.to_weight_matrix(topo)
+    else:
+        W = np.zeros((n, n))
+        for d in range(n):
+            srcs = sorted(topo.predecessors(d))
+            for s in srcs:
+                W[s, d] = 1.0 / len(srcs)
+    return W.T @ vals.astype(np.float64)
+
+
+def _setup(rng, cpu_devices):
+    n = int(rng.integers(2, 9))
+    density = float(rng.uniform(0.1, 0.9))
+    weighted = bool(rng.integers(0, 2))
+    topo = random_digraph(rng, n, density, weighted)
+    bf.init(devices=cpu_devices[:n], nodes_per_machine=1)
+    bf.set_topology(topo, is_weighted=weighted)
+    vals = rng.normal(size=(n, DIM))
+    return n, topo, weighted, vals
+
+
+@pytest.mark.parametrize("seed", range(20))
+def test_random_digraph_end_to_end(seed, cpu_devices):
+    """Unfused eager op AND the fused pytree path against the dense oracle
+    on the same random graph."""
+    rng = np.random.default_rng(seed)
+    n, topo, weighted, vals = _setup(rng, cpu_devices)
+    try:
+        x = jnp.asarray(vals, jnp.float32)
+        out = bf.neighbor_allreduce(x)
+        expected = oracle(topo, weighted, vals)
+        np.testing.assert_allclose(np.asarray(out), expected, rtol=1e-4,
+                                   atol=1e-5)
+
+        # fused: two f32 leaves of different shapes share one flat buffer
+        # (the strategy layer's dataflow, reference fusion buffers §2.4)
+        vals2 = rng.normal(size=(n, 3))
+        comm = bfopt.neighbor_communicator(bf.static_schedule(), fuse=True)
+        fn = jax.jit(jax.shard_map(
+            lambda t: comm(t, 0), mesh=bf.mesh(),
+            in_specs=P("rank"), out_specs=P("rank")))
+        out_tree = fn({"a": x, "b": jnp.asarray(vals2, jnp.float32)})
+        np.testing.assert_allclose(np.asarray(out_tree["a"]), expected,
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(out_tree["b"]),
+                                   oracle(topo, weighted, vals2),
+                                   rtol=1e-4, atol=1e-5)
+    finally:
+        bf.shutdown()
+
+
+@pytest.mark.parametrize("seed", [101, 102, 103])
+def test_random_digraph_wire_codec(seed, cpu_devices):
+    """bf16 wire compression on a random graph: same oracle, quantization
+    tolerance (the self term stays full-precision by design)."""
+    rng = np.random.default_rng(seed)
+    n, topo, weighted, vals = _setup(rng, cpu_devices)
+    try:
+        out = bf.neighbor_allreduce(jnp.asarray(vals, jnp.float32),
+                                    wire="bf16")
+        expected = oracle(topo, weighted, vals)
+        np.testing.assert_allclose(np.asarray(out), expected, rtol=2e-2,
+                                   atol=2e-2)
+    finally:
+        bf.shutdown()
+
+
+@pytest.mark.parametrize("seed", [201, 202, 203, 204, 205])
+def test_random_digraph_dst_weighting(seed, cpu_devices):
+    """Explicit self/src/dst weights on random edges: the sender scales
+    per-edge before the permute (reference fusion-buffer trick,
+    mpi_controller.cc:1394-1454); oracle applies both factors."""
+    rng = np.random.default_rng(seed)
+    n = int(rng.integers(2, 9))
+    topo = random_digraph(rng, n, float(rng.uniform(0.2, 0.8)), False)
+    bf.init(devices=cpu_devices[:n], nodes_per_machine=1)
+    try:
+        edges = [(s, d) for s, d in topo.edges if s != d]
+        sw = rng.uniform(0.2, 0.8, n)
+        srcw = [{s: float(rng.uniform(0.1, 0.5))
+                 for s, d in edges if d == r} for r in range(n)]
+        dstw = [{d: float(rng.uniform(0.5, 2.0))
+                 for s, d in edges if s == r} for r in range(n)]
+        vals = rng.normal(size=(n, DIM))
+        out = bf.neighbor_allreduce(
+            jnp.asarray(vals, jnp.float32),
+            self_weight=[float(w) for w in sw],
+            src_weights=srcw, dst_weights=dstw)
+        expected = np.zeros((n, DIM))
+        for r in range(n):
+            expected[r] = sw[r] * vals[r] + sum(
+                srcw[r][s] * dstw[s][r] * vals[s] for s in srcw[r])
+        np.testing.assert_allclose(np.asarray(out), expected, rtol=1e-4,
+                                   atol=1e-5)
+    finally:
+        bf.shutdown()

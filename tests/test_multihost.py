@@ -154,6 +154,81 @@ FOURPROC_CHILD = textwrap.dedent("""
         r = shard.index[0].start
         got = float(np.asarray(shard.data)[0, 0])
         assert abs(got - expected[r // 2]) < 1e-5, (r, got)
+
+    # ---- window/gossip strategies across the process boundaries (round-5
+    # verdict item #5; invariants of reference torch_win_ops_test.py:780-863
+    # under real jax.distributed) ----
+    import optax
+    from bluefog_tpu import optimizers as bfopt
+    from bluefog_tpu import schedule as sch_mod
+
+    topo = tu.ExponentialTwoGraph(n)
+    bf.set_topology(topo)
+    shard = lambda t: jax.tree.map(bf.shard_distributed, t)
+
+    def rep(a):
+        # replicated jit output: every process holds a full copy
+        return np.asarray(bf.synchronize(a).addressable_shards[0].data)
+
+    # (a) push-sum mass conservation: the accumulate+collect round moves
+    # mass between processes (2 devices each), the rank-axis SUM of the
+    # extended [value..., p] tensor must not change
+    rng = np.random.default_rng(3)
+    vals = rng.normal(size=(n, 4)).astype("float32")
+    ext = bf.shard_distributed(jnp.concatenate(
+        [jnp.asarray(vals), jnp.ones((n, 1), jnp.float32)], axis=1))
+    bf.win_create(ext, "ps", zero_init=True)
+    out_deg = len(tu.GetOutNeighbors(topo, 0))
+    scale = 1.0 / (out_deg + 1)
+    dsts = [{d: scale for d in tu.GetOutNeighbors(topo, r)}
+            for r in range(n)]
+    ones_in = [{s: 1.0 for s in tu.GetInNeighbors(topo, r)}
+               for r in range(n)]
+    tot = jax.jit(lambda a: a.sum(0))
+    x = ext
+    total0 = rep(tot(x))
+    for _ in range(4):
+        bf.win_accumulate(x, "ps", dst_weights=dsts)
+        x = bf.synchronize(bf.win_update(
+            "ps", self_weight=scale, neighbor_weights=ones_in, reset=True))
+        total = rep(tot(x))
+        assert np.allclose(total, total0, rtol=1e-4), (total, total0)
+    bf.win_free("ps")
+
+    # (b) win_put mailbox-gossip train step: the one-step-stale put crosses
+    # the process boundary each step; loss must decrease
+    def qgrad(params, batch):
+        return jax.value_and_grad(
+            lambda p: jnp.mean((p["w"] - batch) ** 2))(params)
+
+    for tag, strat in [
+        ("win_put", bfopt.win_put_optimizer(optax.sgd(0.2))),
+        # (c) dynamic one-peer gossip: the per-step lax.switch over the
+        # period's compiled schedules, stepped across the boundary
+        ("dynamic", bfopt.adapt_with_combine(
+            optax.sgd(0.2), bfopt.neighbor_communicator(
+                schedules=sch_mod.compile_dynamic_schedules(
+                    lambda r: tu.GetDynamicOnePeerSendRecvRanks(topo, r),
+                    n)))),
+    ]:
+        params = shard({"w": jnp.broadcast_to(
+            jnp.arange(float(n))[:, None], (n, 5))})
+        state = shard(bfopt.init_distributed(strat, params))
+        # 5 steps per compiled call: win_put's zero-initialized mailboxes
+        # perturb the first few steps (one-step-stale gossip), so single
+        # steps are non-monotone — judge the 40-step trajectory instead
+        step = bfopt.make_train_step(qgrad, strat, steps_per_call=5)
+        target = bf.shard_distributed(jnp.broadcast_to(
+            jnp.full((n, 5), 2.0)[:, None], (n, 5, 5)))
+        losses = []
+        for _ in range(8):
+            params, state, loss = step(params, state, target)
+            losses.append(float(np.mean(
+                [np.asarray(sh.data)
+                 for sh in bf.synchronize(loss).addressable_shards])))
+        assert losses[-1] < losses[0], (tag, losses)
+        assert losses[-1] < 0.5, (tag, losses)
+
     print(f"proc {jax.process_index()}: FOURPROC-OK", flush=True)
 """ % REPO)
 
@@ -179,6 +254,6 @@ def test_four_process_launch_via_H_fanout(tmp_path):
          "-H", "h0,h1,h2,h3", "--remote-shell", str(stub),
          "--coordinator", f"127.0.0.1:{_free_port()}",
          sys.executable, str(script)],
-        cwd=REPO, env=env, capture_output=True, text=True, timeout=420)
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=900)
     assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
     assert r.stdout.count("FOURPROC-OK") == 4, r.stdout

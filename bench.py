@@ -110,9 +110,10 @@ def tunnel_client_lock(wait_s=None, poll_s=5.0):
                 held = True
                 break
             except OSError:
-                if time.monotonic() >= deadline:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
                     break
-                time.sleep(poll_s)
+                time.sleep(min(poll_s, remaining))
         yield held
     finally:
         if held:
@@ -425,6 +426,23 @@ def main():
         lock_cm = tunnel_client_lock()
     with contextlib.ExitStack() as stack:
         held = stack.enter_context(lock_cm)
+        if not held:
+            # The holder is almost certainly the hw_watch battery.  If the
+            # probe state says the tunnel is UP, falling back now would
+            # squander the round's only accelerator window on a CPU line —
+            # wait one long extra round for the battery to drain instead.
+            state = read_probe_state()
+            extra = _env_float("BLUEFOG_BENCH_TUNNEL_WAIT_BUSY", 2700.0)
+            # freshness window tied to the wait budget: out-waiting a long
+            # battery implies trusting correspondingly older ok=True state
+            fresh_ok = bool(state) and state.get("ok") \
+                and (time.time() - state.get("ts", 0)) < max(extra, 2700.0)
+            if fresh_ok:
+                print("bench: tunnel busy but last probe says the TPU is UP "
+                      f"— waiting up to {extra:.0f}s more for the battery "
+                      "to finish", file=sys.stderr)
+                held = stack.enter_context(
+                    tunnel_client_lock(wait_s=extra, poll_s=15.0))
         if not held:
             stack.close()
             print("bench: tunnel held by another client (hw_watch battery in "

@@ -169,6 +169,33 @@ def test_bench_fast_path_ignores_full_schedule_attempts(paths, monkeypatch):
     assert info["probe_attempts"] == 1
 
 
+def test_bench_waits_longer_when_tunnel_busy_but_up(paths, monkeypatch):
+    """Lock held + fresh ok=True state (battery mid-flight on a LIVE
+    tunnel): bench must take the extended wait rather than immediately
+    recording a CPU fallback — and still fall back once that expires."""
+    import fcntl
+    bench = _load_bench(monkeypatch, paths)
+    bench.write_probe_state(True, 5.0, writer="hw_watch")
+    fd = os.open(bench.TUNNEL_LOCK_FILE, os.O_CREAT | os.O_RDWR)
+    fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+    try:
+        env = dict(os.environ, **paths,
+                   BLUEFOG_BENCH_TUNNEL_WAIT="0.3",
+                   BLUEFOG_BENCH_TUNNEL_WAIT_BUSY="0.6",
+                   BLUEFOG_BENCH_IMAGE_SIZE="32", BLUEFOG_BENCH_CLASSES="10",
+                   JAX_PLATFORMS="cpu")
+        p = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py")],
+            cwd=REPO, env=env, capture_output=True, text=True, timeout=300)
+        assert "waiting up to" in p.stderr, p.stderr[-1500:]
+        line = [ln for ln in p.stdout.splitlines() if ln.strip()][-1]
+        doc = json.loads(line)
+        assert doc["tunnel_busy"] is True        # still landed the fallback
+        assert doc["ok"] is True
+    finally:
+        os.close(fd)
+
+
 def test_bench_full_schedule_when_state_fresh_or_ok(paths, monkeypatch):
     bench = _load_bench(monkeypatch, paths)
     monkeypatch.setenv("BLUEFOG_BENCH_PROBE_SLEEP", "0")

@@ -106,19 +106,59 @@ def test_random_digraph_end_to_end(seed, cpu_devices):
         bf.shutdown()
 
 
+@pytest.mark.parametrize("wire,tol", [("bf16", 2e-2), ("int8", 6e-2)])
 @pytest.mark.parametrize("seed", [101, 102, 103])
-def test_random_digraph_wire_codec(seed, cpu_devices):
-    """bf16 wire compression on a random graph: same oracle, quantization
-    tolerance (the self term stays full-precision by design)."""
+def test_random_digraph_wire_codec(seed, wire, tol, cpu_devices):
+    """Wire compression on a random graph: same oracle, quantization
+    tolerance (the self term stays full-precision by design; int8 is
+    coarser but carries a per-buffer scale)."""
     rng = np.random.default_rng(seed)
     n, topo, weighted, vals = _setup(rng, cpu_devices)
     try:
         out = bf.neighbor_allreduce(jnp.asarray(vals, jnp.float32),
-                                    wire="bf16")
+                                    wire=wire)
         expected = oracle(topo, weighted, vals)
-        np.testing.assert_allclose(np.asarray(out), expected, rtol=2e-2,
-                                   atol=2e-2)
+        np.testing.assert_allclose(np.asarray(out), expected, rtol=tol,
+                                   atol=tol)
     finally:
+        bf.shutdown()
+
+
+@pytest.mark.parametrize("seed", [301, 302, 303, 304, 305])
+def test_random_digraph_win_put_update(seed, cpu_devices):
+    """The window (async-gossip) path on random irregular digraphs: a
+    put + update round must equal the dense oracle — the mailbox slot
+    assignment (one slot per sorted in-neighbor) is where an irregular
+    in-degree bug would hide.  Spec: WinPut + DoWinSync combine,
+    reference mpi_win_ops.cc:345-427."""
+    rng = np.random.default_rng(seed)
+    n, topo, weighted, vals = _setup(rng, cpu_devices)
+    try:
+        x = jnp.asarray(vals, jnp.float32)
+        assert bf.win_create(x, "fz", zero_init=True)
+        bf.win_put(x, "fz")
+        out = bf.win_update("fz")
+        np.testing.assert_allclose(
+            np.asarray(out), oracle(topo, weighted, vals),
+            rtol=1e-4, atol=1e-5)
+
+        # second round with EXPLICIT uniform weights: the update combines
+        # the same mailboxes under caller-supplied weights
+        sw = 0.6
+        nbw = [{s: 0.4 / max(len(list(topo.predecessors(r))) - 1, 1)
+                for s in topo.predecessors(r) if s != r}
+               for r in range(n)]
+        vals2 = np.asarray(out, np.float64)
+        bf.win_put(jnp.asarray(out), "fz")
+        out2 = bf.win_update("fz", self_weight=sw, neighbor_weights=nbw)
+        expected2 = np.zeros((n, DIM))
+        for r in range(n):
+            expected2[r] = sw * vals2[r] + sum(
+                w * vals2[s] for s, w in nbw[r].items())
+        np.testing.assert_allclose(np.asarray(out2), expected2,
+                                   rtol=1e-4, atol=1e-5)
+    finally:
+        bf.win_free()
         bf.shutdown()
 
 

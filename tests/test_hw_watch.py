@@ -123,6 +123,50 @@ def test_tunnel_lock_contention(paths, monkeypatch):
         assert held is True             # free lock acquires instantly
 
 
+def test_second_battery_fires_extended_stage(paths):
+    """With the tunnel staying 'up' (stub), the watcher fires the standard
+    battery then — after the cooldown — the extended '<tag>x' stage."""
+    e = dict(os.environ, **paths)
+    p = subprocess.Popen(
+        [sys.executable, WATCH, "--stub-probe", "true", "--stub-battery",
+         "--no-commit", "--tag", "smoketest", "--interval", "0.5",
+         "--battery-cooldown", "0", "--max-batteries", "2"],
+        cwd=REPO, env=e, stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL)
+    m = paths["BLUEFOG_MEASURED_DIR"]
+    first = os.path.join(m, "battery_smoketest.json")
+    second = os.path.join(m, "battery_smoketestx.json")
+    try:
+        deadline = time.time() + 60
+        while time.time() < deadline and not os.path.exists(second):
+            time.sleep(0.5)
+        assert os.path.exists(first)
+        assert os.path.exists(second), "extended battery never fired"
+        assert json.load(open(first))["stage"] == 0
+        assert json.load(open(second))["stage"] == 1
+    finally:
+        p.kill()
+        p.wait()
+
+
+def test_extended_battery_step_configs():
+    """Stage-1 steps push harder configs under the x-suffix tag and skip
+    the PERFORMANCE.md fill (that belongs to the standard tag)."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location("hw_watch", WATCH)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    steps = mod._battery_steps("rT", stage=1)
+    names = [s[0] for s in steps]
+    assert "perf_fill" not in names
+    assert "tpu_validate" not in names            # once is enough
+    bench = next(s for s in steps if s[0] == "bench_big")
+    assert bench[4]["BLUEFOG_BENCH_BATCH"] == "128"
+    assert any("bench_rTx.json" in str(a) for a in bench[3:4])
+    lm = next(s for s in steps if s[0] == "lm_bench_long")
+    assert "8192" in lm[1]
+
+
 def test_battery_resolves_steps_at_fire_time(paths):
     # the battery list must include lm_bench/trace_analyze/perf_fill only
     # when the files exist — resolved when the probe succeeds, not at start

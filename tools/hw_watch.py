@@ -17,7 +17,11 @@ successful probe it runs the full measurement battery unattended, in order:
     tools/trace_analyze.py …                 → trace_split_<tag>.json (if present)
     tools/perf_fill.py --tag <tag>           → PERFORMANCE.md headline (if present)
 
-then commits the artifact paths.  The battery list is resolved when the
+then commits the artifact paths.  If the tunnel is still up on a later
+probe (>= --battery-cooldown after the first battery), a SECOND, extended
+battery fires under a ``<tag>x`` suffix — bigger batch, longer sequence,
+wider sweep — so extra tunnel-hours buy headroom data beyond the
+reference-comparable configs.  The battery list is resolved when the
 probe succeeds (not at watcher start), so tools added while the watcher is
 already running are picked up.  Single-client discipline: the watcher is
 the ONLY process that should dial the tunnel while it runs (the axon relay
@@ -119,39 +123,72 @@ def probe(timeout_s: float, stub: str | None) -> bool:
     return _bench._probe(_probe_env(), timeout_s)
 
 
-def _battery_steps(tag: str) -> list:
-    """(name, argv, timeout_s, stdout_capture_path|None), resolved at fire
-    time so tools added after watcher start are included."""
+def _battery_steps(tag: str, stage: int = 0) -> list:
+    """(name, argv, timeout_s, stdout_capture_path|None, extra_env|None),
+    resolved at fire time so tools added after watcher start are included.
+
+    Stage 0 is the standard battery (reference-comparable configs + the
+    PERFORMANCE.md fill).  Stage 1 — fired on a later successful probe if
+    the tunnel stays up — pushes the same tools harder (bigger batch,
+    longer sequence, wider sweep) under a ``<tag>x`` suffix: once the
+    parity numbers are banked, extra tunnel-hours buy headroom data."""
     py = sys.executable
     m = MEASURED
+    lm = os.path.join(REPO, "tools", "lm_bench.py")
+    ta = os.path.join(REPO, "tools", "trace_analyze.py")
+    pf = os.path.join(REPO, "tools", "perf_fill.py")
+    if stage > 0:
+        tag = f"{tag}x"
+        steps = [
+            ("bench_big", [py, os.path.join(REPO, "bench.py")], 3600,
+             os.path.join(m, f"bench_{tag}.json"),
+             {"BLUEFOG_BENCH_BATCH": "128", "BLUEFOG_BENCH_ITERS": "20",
+              "BLUEFOG_BENCH_STEPS_PER_CALL": "10"}),
+            ("step_sweep_wide",
+             [py, os.path.join(REPO, "tools", "step_sweep.py"),
+              "--sweep", "1,2,5,10,20", "--batch", "128",
+              "--out", os.path.join(m, f"step_sweep_{tag}.json"),
+              "--trace", os.path.join(m, f"trace_{tag}")], 5400, None, None),
+        ]
+        if os.path.exists(lm):
+            steps.append(("lm_bench_long",
+                          [py, lm, "--seq", "8192", "--batch", "8",
+                           "--out", os.path.join(m, f"lm_bench_{tag}.json")],
+                          3600, None, None))
+        if os.path.exists(ta):
+            steps.append(("trace_analyze",
+                          [py, ta, os.path.join(m, f"trace_{tag}"),
+                           "--out",
+                           os.path.join(m, f"trace_split_{tag}.json")],
+                          600, None, None))
+        return steps
     steps = [
         ("bench", [py, os.path.join(REPO, "bench.py")], 3600,
-         os.path.join(m, f"bench_{tag}.json")),
+         os.path.join(m, f"bench_{tag}.json"), None),
         ("tpu_validate",
          [py, os.path.join(REPO, "tools", "tpu_validate.py"),
-          "--out", os.path.join(m, f"tpu_validate_{tag}.json")], 3600, None),
+          "--out", os.path.join(m, f"tpu_validate_{tag}.json")],
+         3600, None, None),
         ("chip_calibrate",
          [py, os.path.join(REPO, "tools", "chip_calibrate.py")], 2400,
-         os.path.join(m, f"chip_calibrate_{tag}.json")),
+         os.path.join(m, f"chip_calibrate_{tag}.json"), None),
         ("step_sweep",
          [py, os.path.join(REPO, "tools", "step_sweep.py"),
           "--out", os.path.join(m, f"step_sweep_{tag}.json"),
-          "--trace", os.path.join(m, f"trace_{tag}")], 5400, None),
+          "--trace", os.path.join(m, f"trace_{tag}")], 5400, None, None),
     ]
-    lm = os.path.join(REPO, "tools", "lm_bench.py")
     if os.path.exists(lm):
         steps.append(("lm_bench",
-                      [py, lm, "--out", os.path.join(m, f"lm_bench_{tag}.json")],
-                      3600, None))
-    ta = os.path.join(REPO, "tools", "trace_analyze.py")
+                      [py, lm, "--out",
+                       os.path.join(m, f"lm_bench_{tag}.json")],
+                      3600, None, None))
     if os.path.exists(ta):
         steps.append(("trace_analyze",
                       [py, ta, os.path.join(m, f"trace_{tag}"),
                        "--out", os.path.join(m, f"trace_split_{tag}.json")],
-                      600, None))
-    pf = os.path.join(REPO, "tools", "perf_fill.py")
+                      600, None, None))
     if os.path.exists(pf):
-        steps.append(("perf_fill", [py, pf, "--tag", tag], 600, None))
+        steps.append(("perf_fill", [py, pf, "--tag", tag], 600, None, None))
     return steps
 
 
@@ -168,15 +205,16 @@ def _bench_env() -> dict:
     return env
 
 
-def run_battery(tag: str, stub: bool, no_commit: bool) -> dict:
+def run_battery(tag: str, stub: bool, no_commit: bool,
+                stage: int = 0) -> dict:
     os.makedirs(MEASURED, exist_ok=True)
     logdir = os.path.join(MEASURED, "logs")
     os.makedirs(logdir, exist_ok=True)
     results = {}
     steps = ([("stub", [sys.executable, "-c", "print('{\"stub\": true}')"],
-               60, os.path.join(MEASURED, f"bench_{tag}.json"))]
-             if stub else _battery_steps(tag))
-    for name, argv, timeout_s, capture in steps:
+               60, os.path.join(MEASURED, f"bench_{tag}.json"), None)]
+             if stub else _battery_steps(tag, stage))
+    for name, argv, timeout_s, capture, extra_env in steps:
         t0 = time.monotonic()
         log_path = os.path.join(logdir, f"{name}_{tag}.log")
         print(f"hw_watch: battery step '{name}' starting "
@@ -187,8 +225,11 @@ def run_battery(tag: str, stub: bool, no_commit: bool) -> dict:
             # subprocesses, and an orphaned dialer hanging on the tunnel
             # would recreate the concurrent-dial wedge the lock prevents
             with open(log_path, "w") as logf:
+                env = _bench_env()
+                if extra_env:
+                    env.update(extra_env)
                 p = subprocess.Popen(
-                    argv, env=_bench_env(), cwd=REPO, text=True,
+                    argv, env=env, cwd=REPO, text=True,
                     stdout=subprocess.PIPE, stderr=logf,
                     start_new_session=True)
                 try:
@@ -227,8 +268,11 @@ def run_battery(tag: str, stub: bool, no_commit: bool) -> dict:
                              "seconds": round(time.monotonic() - t0, 1)}
         print(f"hw_watch: battery step '{name}' -> {results[name]}",
               flush=True)
-    summary = {"tag": tag, "utc": _utcnow(), "steps": results}
-    with open(os.path.join(MEASURED, f"battery_{tag}.json"), "w") as f:
+    summary_tag = f"{tag}x" if stage > 0 else tag
+    summary = {"tag": summary_tag, "stage": stage, "utc": _utcnow(),
+               "steps": results}
+    with open(os.path.join(MEASURED, f"battery_{summary_tag}.json"),
+              "w") as f:
         json.dump(summary, f, indent=1)
     if not no_commit:
         _commit_artifacts(tag)
@@ -254,9 +298,13 @@ def main() -> int:
     ap.add_argument("--interval", type=float, default=600.0,
                     help="seconds between probes (default 600)")
     ap.add_argument("--probe-timeout", type=float, default=150.0)
-    ap.add_argument("--max-batteries", type=int, default=1,
-                    help="stop firing the battery after this many successes "
-                         "(probing continues, keeping the state file fresh)")
+    ap.add_argument("--max-batteries", type=int, default=2,
+                    help="total batteries to fire: the first is the "
+                         "standard (reference-comparable) set, later ones "
+                         "the extended '<tag>x' set; probing continues "
+                         "afterwards, keeping the state file fresh")
+    ap.add_argument("--battery-cooldown", type=float, default=1800.0,
+                    help="seconds after a battery before the next may fire")
     ap.add_argument("--once", action="store_true",
                     help="single probe (plus battery on success) then exit")
     ap.add_argument("--tag", default=os.environ.get("BLUEFOG_ROUND", "r05"),
@@ -273,6 +321,7 @@ def main() -> int:
               file=sys.stderr)
         return 3
     batteries = 0
+    last_battery_end = None
     try:
         while True:
             t0 = time.monotonic()
@@ -293,10 +342,15 @@ def main() -> int:
                 _bench.write_probe_state(ok, dt, writer="hw_watch")
                 log_probe(ok, dt)
                 print(f"hw_watch: probe ok={ok} dt={dt:.1f}s", flush=True)
-                if ok and batteries < args.max_batteries:
+                cooled = (last_battery_end is None
+                          or time.monotonic() - last_battery_end
+                          >= args.battery_cooldown)
+                if ok and batteries < args.max_batteries and cooled:
+                    stage = batteries       # 0 = standard, 1+ = extended
                     batteries += 1
                     summary = run_battery(args.tag, args.stub_battery,
-                                          args.no_commit)
+                                          args.no_commit, stage=stage)
+                    last_battery_end = time.monotonic()
                     log_probe(True, dt, note=f" battery={summary['steps']}")
             if args.once:
                 return 0 if ok else 1

@@ -124,6 +124,34 @@ def test_random_digraph_wire_codec(seed, wire, tol, cpu_devices):
         bf.shutdown()
 
 
+@pytest.mark.parametrize("seed", [401, 402, 403, 404])
+def test_random_digraph_neighbor_allgather(seed, cpu_devices):
+    """Irregular in-degrees through neighbor_allgather: slices arrive
+    sorted by source rank, slots beyond a rank's in-degree stay zero —
+    the slot/padding layout is per-rank on random graphs (spec:
+    reference order guarantees, test/torch_ops_test.py:1246-1286)."""
+    rng = np.random.default_rng(seed)
+    n, topo, weighted, vals = _setup(rng, cpu_devices)
+    try:
+        d0 = 3
+        x = jnp.asarray(
+            np.repeat(vals[:, :1], d0, 1)[..., None], jnp.float32)
+        out = bf.neighbor_allgather(x)          # [n, max_in * d0, 1]
+        sched = bf.static_schedule()
+        max_in = sched.max_in_degree
+        assert out.shape == (n, max_in * d0, 1)
+        got = np.asarray(out)
+        for r in range(n):
+            srcs = sorted(s for s in topo.predecessors(r) if s != r)
+            expected = np.zeros((max_in * d0, 1))
+            for k, s in enumerate(srcs):
+                expected[k * d0:(k + 1) * d0] = vals[s, 0]
+            np.testing.assert_allclose(got[r], expected, rtol=1e-5,
+                                       atol=1e-6)
+    finally:
+        bf.shutdown()
+
+
 @pytest.mark.parametrize("seed", [301, 302, 303, 304, 305])
 def test_random_digraph_win_put_update(seed, cpu_devices):
     """The window (async-gossip) path on random irregular digraphs: a

@@ -58,18 +58,30 @@ def save(directory: str, state: Any, step: int, *, keep: Optional[int] = None) -
 
 def restore(path: str, template: Optional[Any] = None) -> Any:
     """Load a checkpoint; ``template`` (matching pytree of ShapeDtypeStruct or
-    arrays) restores with the original structure/dtypes when given."""
+    arrays) restores with the original structure/dtypes when given.
+
+    Template leaves without sharding info are part of the contract (host
+    arrays, elastic restores onto a different topology): orbax then reads
+    the sharding from the checkpoint's sharding file, which is exactly the
+    intended behavior — its advisory UserWarning about that fallback is
+    suppressed here so intentional use stays noise-free."""
+    import warnings
+
     ckpt = _checkpointer()
-    if template is not None:
-        import orbax.checkpoint as ocp
-        template = jax.tree.map(
-            lambda x: ocp.utils.to_shape_dtype_struct(x)
-            if hasattr(ocp.utils, "to_shape_dtype_struct") else x, template)
-        try:
-            return ckpt.restore(path, item=template)
-        except TypeError:
-            return ckpt.restore(path)
-    return ckpt.restore(path)
+    with warnings.catch_warnings():
+        warnings.filterwarnings(
+            "ignore", message="Sharding info not provided when restoring")
+        if template is not None:
+            import orbax.checkpoint as ocp
+            template = jax.tree.map(
+                lambda x: ocp.utils.to_shape_dtype_struct(x)
+                if hasattr(ocp.utils, "to_shape_dtype_struct") else x,
+                template)
+            try:
+                return ckpt.restore(path, item=template)
+            except TypeError:
+                return ckpt.restore(path)
+        return ckpt.restore(path)
 
 
 def all_steps(directory: str):

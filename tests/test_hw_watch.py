@@ -167,6 +167,29 @@ def test_extended_battery_step_configs():
     assert "8192" in lm[1]
 
 
+def test_rehearsal_steps_are_cpu_safe():
+    """--rehearse mirrors the real battery with CPU-pinned smoke args:
+    every step must either pin JAX_PLATFORMS=cpu / pass a cpu-safe flag
+    so nothing dials the tunnel, and perf_fill stays --dry-run."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location("hw_watch", WATCH)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    steps = mod._rehearsal_steps("rT-rehearsal")
+    names = [s[0] for s in steps]
+    assert names == ["bench", "tpu_validate", "chip_calibrate",
+                     "step_sweep", "lm_bench", "trace_analyze", "perf_fill"]
+    for name, argv, _timeout, _cap, env in steps:
+        cpu_safe = ((env or {}).get("JAX_PLATFORMS") == "cpu"
+                    or (env or {}).get("BLUEFOG_BENCH_FORCE_CPU") == "1"
+                    or "--smoke" in argv or "--allow-cpu" in argv
+                    or "--virtual-cpu" in argv
+                    or name in ("trace_analyze", "perf_fill"))  # no jax
+        assert cpu_safe, name
+    pf = next(s for s in steps if s[0] == "perf_fill")
+    assert "--dry-run" in pf[1]
+
+
 def test_battery_resolves_steps_at_fire_time(paths):
     # the battery list must include lm_bench/trace_analyze/perf_fill only
     # when the files exist — resolved when the probe succeeds, not at start

@@ -192,6 +192,50 @@ def _battery_steps(tag: str, stage: int = 0) -> list:
     return steps
 
 
+def _rehearsal_steps(tag: str) -> list:
+    """CPU-safe smoke variants of the REAL battery commands: same tools,
+    same artifact plumbing, tiny shapes.  Validates the full sequencing /
+    capture / trace-analysis / fill pipeline end-to-end before the
+    one-shot hardware window (tpu_validate still refuses off-TPU, which
+    exercises the continue-on-failure path)."""
+    py = sys.executable
+    m = MEASURED
+    smoke_env = {"BLUEFOG_BENCH_FORCE_CPU": "1", "JAX_PLATFORMS": "cpu",
+                 "BLUEFOG_BENCH_IMAGE_SIZE": "32",
+                 "BLUEFOG_BENCH_CLASSES": "10",
+                 "BLUEFOG_COMPILE_CACHE": "off"}
+    return [
+        ("bench", [py, os.path.join(REPO, "bench.py")], 900,
+         os.path.join(m, f"bench_{tag}.json"), smoke_env),
+        ("tpu_validate",
+         [py, os.path.join(REPO, "tools", "tpu_validate.py"),
+          "--out", os.path.join(m, f"tpu_validate_{tag}.json")],
+         300, None, {"JAX_PLATFORMS": "cpu"}),
+        ("chip_calibrate",
+         [py, os.path.join(REPO, "tools", "chip_calibrate.py"), "--smoke"],
+         600, os.path.join(m, f"chip_calibrate_{tag}.json"), None),
+        ("step_sweep",
+         [py, os.path.join(REPO, "tools", "step_sweep.py"),
+          "--sweep", "1,2", "--batch", "1", "--iters", "1", "--allow-cpu",
+          "--out", os.path.join(m, f"step_sweep_{tag}.json"),
+          "--trace", os.path.join(m, f"trace_{tag}")], 1200, None,
+         smoke_env),
+        ("lm_bench",
+         [py, os.path.join(REPO, "tools", "lm_bench.py"),
+          "--virtual-cpu", "--smoke",
+          "--out", os.path.join(m, f"lm_bench_{tag}.json")], 900, None,
+         None),
+        ("trace_analyze",
+         [py, os.path.join(REPO, "tools", "trace_analyze.py"),
+          os.path.join(m, f"trace_{tag}"),
+          "--out", os.path.join(m, f"trace_split_{tag}.json")], 300, None,
+         None),
+        ("perf_fill",
+         [py, os.path.join(REPO, "tools", "perf_fill.py"), "--tag", tag,
+          "--dry-run"], 300, None, None),
+    ]
+
+
 def _bench_env() -> dict:
     """The tunnel just answered a probe — bench need not re-probe slowly.
     The watcher holds the tunnel lock for the whole battery, so children
@@ -206,14 +250,19 @@ def _bench_env() -> dict:
 
 
 def run_battery(tag: str, stub: bool, no_commit: bool,
-                stage: int = 0) -> dict:
+                stage: int = 0, rehearse: bool = False) -> dict:
     os.makedirs(MEASURED, exist_ok=True)
     logdir = os.path.join(MEASURED, "logs")
     os.makedirs(logdir, exist_ok=True)
     results = {}
-    steps = ([("stub", [sys.executable, "-c", "print('{\"stub\": true}')"],
-               60, os.path.join(MEASURED, f"bench_{tag}.json"), None)]
-             if stub else _battery_steps(tag, stage))
+    if stub:
+        steps = [("stub",
+                  [sys.executable, "-c", "print('{\"stub\": true}')"],
+                  60, os.path.join(MEASURED, f"bench_{tag}.json"), None)]
+    elif rehearse:
+        steps = _rehearsal_steps(tag)
+    else:
+        steps = _battery_steps(tag, stage)
     for name, argv, timeout_s, capture, extra_env in steps:
         t0 = time.monotonic()
         log_path = os.path.join(logdir, f"{name}_{tag}.log")
@@ -314,7 +363,30 @@ def main() -> int:
     ap.add_argument("--stub-battery", action="store_true",
                     help="testing: replace the battery with a stub step")
     ap.add_argument("--no-commit", action="store_true")
+    ap.add_argument("--rehearse", action="store_true",
+                    help="run the battery ONCE NOW with CPU-safe smoke "
+                         "args (no probe needed): validates the full "
+                         "pipeline before a hardware window; implies "
+                         "--no-commit")
     args = ap.parse_args()
+
+    if args.rehearse:
+        # no tunnel dial happens here, but hold the tunnel lock anyway: a
+        # rehearsal racing a REAL battery would steal host CPU from (and
+        # interleave logs with) the one-shot hardware measurements
+        with _bench.tunnel_client_lock(wait_s=0.0) as held:
+            if not held:
+                print("hw_watch: tunnel lock busy (real battery in "
+                      "flight?); not rehearsing now", file=sys.stderr)
+                return 4
+            # suffixed tag: rehearsal artifacts never shadow real ones
+            summary = run_battery(f"{args.tag}-rehearsal", stub=False,
+                                  no_commit=True, rehearse=True)
+        print(json.dumps(summary))
+        bad = [n for n, r in summary["steps"].items()
+               if r["rc"] != 0
+               and not (n == "tpu_validate" and r["rc"] == 2)]
+        return 0 if not bad else 1
 
     if not acquire_lock():
         print("hw_watch: another instance holds the lock; exiting",

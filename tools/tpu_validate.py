@@ -11,6 +11,7 @@ Run (needs the TPU tunnel, single client):  python tools/tpu_validate.py
 Prints one JSON line per check: {"check", "ok", ...details}.
 """
 import json
+import os
 import sys
 import time
 
@@ -223,6 +224,11 @@ def main():
         if a == "--out" and i + 1 < len(sys.argv):
             out_path = sys.argv[i + 1]
 
+    # honor an explicit CPU pin: the axon plugin force-overrides the
+    # JAX_PLATFORMS env var at boot, so without this a CPU-pinned run
+    # (battery rehearsal, CI) dials the TPU tunnel just to refuse
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        jax.config.update("jax_platforms", "cpu")
     dev = jax.devices()[0]
     if dev.platform == "cpu":
         print("refusing: no accelerator", file=sys.stderr)
@@ -241,7 +247,6 @@ def main():
     summary = {"summary": "PASS" if ok else "FAIL", "n_checks": len(RESULTS)}
     print(json.dumps(summary))
     if out_path:
-        import os
         os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
         with open(out_path, "w") as f:
             json.dump({"device": dev.device_kind, "results": RESULTS,

@@ -197,11 +197,71 @@ def test_battery_resolves_steps_at_fire_time(paths):
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     names = [s[0] for s in mod._battery_steps("x")]
-    assert names[:4] == ["bench", "tpu_validate", "chip_calibrate",
-                         "step_sweep"]
+    # pure-XLA measurements land before the Pallas-compiling steps: a
+    # wedged Mosaic compile must not cost the calibrate/sweep/LM numbers
+    assert names[:3] == ["bench", "chip_calibrate", "step_sweep"]
     for optional in ("lm_bench", "trace_analyze", "perf_fill"):
         tool = os.path.join(REPO, "tools", f"{optional}.py")
         assert (optional in names) == os.path.exists(tool)
+    if "lm_bench" in names:     # XLA LM first, pallas variant after,
+        assert (names.index("lm_bench")          # validate last of the
+                < names.index("lm_bench_pallas")  # tunnel-dialing steps
+                < names.index("tpu_validate"))
+
+
+def test_battery_aborts_when_tunnel_dies_mid_run(paths, monkeypatch, tmp_path):
+    """A timed-out step triggers settle + re-probe; a dead tunnel aborts
+    the remaining steps instead of burning every timeout in sequence."""
+    for k, v in paths.items():
+        monkeypatch.setenv(k, v)
+    monkeypatch.setenv("BLUEFOG_HW_WATCH_SETTLE", "0")
+    spec = importlib.util.spec_from_file_location("hw_watch_abort", WATCH)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    py = sys.executable
+    steps = [
+        ("hang", [py, "-c", "import time; time.sleep(60)"], 1, None, None),
+        ("never", [py, "-c", "print('{}')"], 30, None, None),
+        # local-only steps still run after a dead-tunnel abort: the
+        # PERFORMANCE.md fill must happen on whatever was banked
+        ("perf_fill", [py, "-c", "print('filled')"], 30, None, None),
+    ]
+    monkeypatch.setattr(mod, "_battery_steps", lambda tag, stage=0: steps)
+    monkeypatch.setattr(mod, "probe", lambda *a, **k: False)
+    recorded = []
+    monkeypatch.setattr(
+        mod._bench, "write_probe_state",
+        lambda ok, s, writer="": recorded.append((ok, writer)))
+    summary = mod.run_battery("aborttest", stub=False, no_commit=True)
+    assert summary["steps"]["hang"]["rc"] == "timeout"
+    assert summary["steps"]["never"]["rc"] == "skipped: tunnel unreachable"
+    assert summary["steps"]["perf_fill"]["rc"] == 0
+    assert "aborted after hang" in summary["steps"]["_battery"]["rc"]
+    # the dead re-probe was recorded for bench.py's fast-fallback path
+    assert (False, "hw_watch") in recorded
+
+
+def test_battery_continues_when_tunnel_survives_timeout(paths, monkeypatch):
+    """Same wedge, but the re-probe says the tunnel is alive: the next
+    step still runs (one lost step, not a lost battery)."""
+    for k, v in paths.items():
+        monkeypatch.setenv(k, v)
+    monkeypatch.setenv("BLUEFOG_HW_WATCH_SETTLE", "0")
+    spec = importlib.util.spec_from_file_location("hw_watch_cont", WATCH)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    py = sys.executable
+    steps = [
+        ("hang", [py, "-c", "import time; time.sleep(60)"], 1, None, None),
+        ("after", [py, "-c", "print('ok')"], 30, None, None),
+    ]
+    monkeypatch.setattr(mod, "_battery_steps", lambda tag, stage=0: steps)
+    monkeypatch.setattr(mod, "probe", lambda *a, **k: True)
+    monkeypatch.setattr(mod._bench, "write_probe_state",
+                        lambda *a, **k: None)
+    summary = mod.run_battery("conttest", stub=False, no_commit=True)
+    assert summary["steps"]["hang"]["rc"] == "timeout"
+    assert summary["steps"]["after"]["rc"] == 0
 
 
 # ---------- bench.py fast-fallback schedule ----------

@@ -1,0 +1,195 @@
+"""Tests for tools/tpu_validate.py's per-group isolation (round 5).
+
+A remote Mosaic compile can wedge the axon tunnel indefinitely — on the
+first round-5 hardware window the inline script froze on its first kernel
+and burned the battery step's whole 3600 s budget.  Isolated mode runs
+each check group in its own subprocess so a wedge costs one group, and
+re-probes the tunnel after a timeout so a dead tunnel aborts the rest.
+
+Nothing here dials the tunnel: child subprocesses are faked by
+monkeypatching the module's subprocess.Popen, and the probe is stubbed.
+"""
+import argparse
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+SCRIPT = os.path.join(REPO, "tools", "tpu_validate.py")
+
+
+def _load(name="tpu_validate_under_test"):
+    spec = importlib.util.spec_from_file_location(name, SCRIPT)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    mod.RESULTS.clear()
+    return mod
+
+
+def _args(**over):
+    base = dict(group_timeout=5.0, settle_s=0.0, probe_timeout=1.0,
+                budget=300.0, out=None)
+    base.update(over)
+    return argparse.Namespace(**base)
+
+
+class _FakeProc:
+    """Stands in for a --only child: either returns canned JSON lines or
+    'wedges' (communicate raises TimeoutExpired)."""
+
+    def __init__(self, lines=None, wedge=False, returncode=0):
+        self._lines = lines or []
+        self._wedge = wedge
+        self.returncode = returncode
+        self.pid = os.getpid()          # killpg target; see fake killpg
+
+    def communicate(self, timeout=None):
+        if self._wedge:
+            raise subprocess.TimeoutExpired(cmd="child", timeout=timeout)
+        return "\n".join(json.dumps(l) for l in self._lines) + "\n", ""
+
+    def kill(self):
+        pass
+
+    def wait(self):
+        pass
+
+
+def _install_children(monkeypatch, mod, procs):
+    it = iter(procs)
+    monkeypatch.setattr(mod.subprocess, "Popen",
+                        lambda *a, **k: next(it))
+    monkeypatch.setattr(mod.os, "killpg", lambda *a: None)
+
+
+def test_isolated_merges_group_results(monkeypatch):
+    mod = _load()
+    dev = {"check": "device", "ok": True, "kind": "TPU v5 lite",
+           "platform": "axon"}
+    _install_children(monkeypatch, mod, [
+        _FakeProc([dev, {"check": "a", "ok": True}]),
+        _FakeProc([dev, {"check": "b", "ok": True}]),
+    ])
+    device = mod._run_isolated(_args(), ["fwd_1k", "fwd_768"])
+    assert device == "TPU v5 lite"
+    checks = [r["check"] for r in mod.RESULTS]
+    assert checks == ["device", "a", "b"]       # device line echoed once
+    assert all(r["ok"] for r in mod.RESULTS)
+
+
+def test_isolated_wedged_group_costs_one_group(monkeypatch):
+    """First group wedges; probe says the tunnel survived; the second
+    group still runs and its results land."""
+    mod = _load()
+    dev = {"check": "device", "ok": True, "kind": "TPU v5 lite"}
+    _install_children(monkeypatch, mod, [
+        _FakeProc(wedge=True),
+        _FakeProc([dev, {"check": "later", "ok": True}]),
+    ])
+    monkeypatch.setattr(mod, "_probe_alive", lambda t: True)
+    mod._run_isolated(_args(), ["fwd_1k", "ring"])
+    by_check = {r["check"]: r for r in mod.RESULTS}
+    assert by_check["group_fwd_1k"]["ok"] is False
+    assert by_check["group_fwd_1k"]["error"] == "timeout"
+    assert by_check["later"]["ok"] is True
+
+
+def test_isolated_dead_tunnel_skips_remaining_groups(monkeypatch):
+    mod = _load()
+    _install_children(monkeypatch, mod, [_FakeProc(wedge=True)])
+    monkeypatch.setattr(mod, "_probe_alive", lambda t: False)
+    mod._run_isolated(_args(), ["fwd_1k", "bwd_512", "ring"])
+    by_check = {r["check"]: r for r in mod.RESULTS}
+    assert by_check["group_fwd_1k"]["error"] == "timeout"
+    assert "skipped" in by_check["group_bwd_512"]["error"]
+    assert "skipped" in by_check["group_ring"]["error"]
+    assert not any(r["ok"] for r in mod.RESULTS)
+
+
+def test_isolated_child_crash_is_reported(monkeypatch):
+    mod = _load()
+    _install_children(monkeypatch, mod, [_FakeProc([], returncode=139)])
+    mod._run_isolated(_args(), ["timing"])
+    (rec,) = mod.RESULTS
+    assert rec["check"] == "group_timing"
+    assert rec["ok"] is False and "exit 139" in rec["error"]
+
+
+def test_isolated_writes_out_incrementally(monkeypatch, tmp_path):
+    """--out must be rewritten after every group so an outer kill (the
+    battery's step timeout) keeps completed groups' results."""
+    mod = _load()
+    dev = {"check": "device", "ok": True, "kind": "TPU v5 lite"}
+    out = str(tmp_path / "val.json")
+    seen = []
+
+    class Recorder(_FakeProc):
+        def communicate(self, timeout=None):
+            if os.path.exists(out):
+                seen.append(json.load(open(out))["n_checks"])
+            return super().communicate(timeout)
+
+    it = iter([Recorder([dev, {"check": "a", "ok": True}]),
+               Recorder([dev, {"check": "b", "ok": True}])])
+    monkeypatch.setattr(mod.subprocess, "Popen", lambda *a, **k: next(it))
+    mod._run_isolated(_args(out=out), ["fwd_1k", "fwd_768"])
+    doc = json.load(open(out))
+    assert doc["n_checks"] == 3 and doc["summary"] == "PASS"
+    assert seen == [2]          # group 2 saw group 1's banked results
+
+
+def test_isolated_budget_exhaustion_skips_rest(monkeypatch):
+    mod = _load()
+    _install_children(monkeypatch, mod, [])   # nothing may spawn
+    mod._run_isolated(_args(budget=0.0), ["fwd_1k", "ring"])
+    assert [r["check"] for r in mod.RESULTS] == ["group_fwd_1k",
+                                                 "group_ring"]
+    assert all("budget exhausted" in r["error"] for r in mod.RESULTS)
+
+
+def test_accelerator_vanishing_mid_run_keeps_results(monkeypatch, tmp_path):
+    """rc 2 from a LATER child (tunnel daemon restarted, CPU only) must
+    not discard the groups already banked."""
+    mod = _load()
+    dev = {"check": "device", "ok": True, "kind": "TPU v5 lite"}
+    out = str(tmp_path / "val.json")
+    _install_children(monkeypatch, mod, [
+        _FakeProc([dev, {"check": "early", "ok": True}]),
+        _FakeProc([], returncode=2),
+    ])
+    mod._run_isolated(_args(out=out), ["fwd_1k", "ring"])
+    doc = json.load(open(out))
+    by_check = {r["check"]: r for r in doc["results"]}
+    assert by_check["early"]["ok"] is True
+    assert "vanished" in by_check["group_ring"]["error"]
+
+
+def test_first_child_rc2_still_refuses(monkeypatch):
+    mod = _load()
+    _install_children(monkeypatch, mod, [_FakeProc([], returncode=2)])
+    with pytest.raises(SystemExit) as e:
+        mod._run_isolated(_args(), ["fwd_1k"])
+    assert e.value.code == 2
+
+
+def test_cpu_pin_refuses_without_spawning():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    p = subprocess.run([sys.executable, SCRIPT], env=env, cwd=REPO,
+                       capture_output=True, text=True, timeout=120)
+    assert p.returncode == 2
+    assert "no accelerator" in p.stderr
+    p = subprocess.run([sys.executable, SCRIPT, "--inline"], env=env,
+                       cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert p.returncode == 2
+
+
+def test_group_list_covers_all_checks():
+    """Every check the old inline main ran has a group; the isolated
+    default runs them all."""
+    mod = _load()
+    assert set(mod.GROUPS) == {"fwd_1k", "fwd_768", "bwd_512", "bwd_384",
+                               "timing", "ring"}

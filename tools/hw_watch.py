@@ -153,7 +153,13 @@ def _battery_steps(tag: str, stage: int = 0) -> list:
         if os.path.exists(lm):
             steps.append(("lm_bench_long",
                           [py, lm, "--seq", "8192", "--batch", "8",
+                           "--no-pallas",
                            "--out", os.path.join(m, f"lm_bench_{tag}.json")],
+                          3600, None, None))
+            steps.append(("lm_bench_long_pallas",
+                          [py, lm, "--seq", "8192", "--batch", "8",
+                           "--out",
+                           os.path.join(m, f"lm_bench_pallas_{tag}.json")],
                           3600, None, None))
         if os.path.exists(ta):
             steps.append(("trace_analyze",
@@ -162,13 +168,14 @@ def _battery_steps(tag: str, stage: int = 0) -> list:
                            os.path.join(m, f"trace_split_{tag}.json")],
                           600, None, None))
         return steps
+    # Pure-XLA measurements first, Pallas last: a remote Mosaic compile
+    # can wedge the axon tunnel (round 5: tpu_validate froze on its first
+    # kernel and ate its whole 3600 s budget while calibrate/sweep/LM
+    # numbers were still unbanked).  The post-timeout probe in
+    # run_battery stops a dead tunnel from burning the remaining steps.
     steps = [
         ("bench", [py, os.path.join(REPO, "bench.py")], 3600,
          os.path.join(m, f"bench_{tag}.json"), None),
-        ("tpu_validate",
-         [py, os.path.join(REPO, "tools", "tpu_validate.py"),
-          "--out", os.path.join(m, f"tpu_validate_{tag}.json")],
-         3600, None, None),
         ("chip_calibrate",
          [py, os.path.join(REPO, "tools", "chip_calibrate.py")], 2400,
          os.path.join(m, f"chip_calibrate_{tag}.json"), None),
@@ -179,9 +186,17 @@ def _battery_steps(tag: str, stage: int = 0) -> list:
     ]
     if os.path.exists(lm):
         steps.append(("lm_bench",
-                      [py, lm, "--out",
+                      [py, lm, "--no-pallas", "--out",
                        os.path.join(m, f"lm_bench_{tag}.json")],
-                      3600, None, None))
+                      2400, None, None))
+        steps.append(("lm_bench_pallas",
+                      [py, lm, "--out",
+                       os.path.join(m, f"lm_bench_pallas_{tag}.json")],
+                      2400, None, None))
+    steps.append(("tpu_validate",
+                  [py, os.path.join(REPO, "tools", "tpu_validate.py"),
+                   "--out", os.path.join(m, f"tpu_validate_{tag}.json")],
+                  3000, None, None))
     if os.path.exists(ta):
         steps.append(("trace_analyze",
                       [py, ta, os.path.join(m, f"trace_{tag}"),
@@ -249,12 +264,22 @@ def _bench_env() -> dict:
     return env
 
 
+# battery steps that never dial the tunnel (they only read local
+# artifacts): exempt from the wedge settle/re-probe and still run after
+# a dead-tunnel abort — PERFORMANCE.md must be filled from whatever the
+# tunnel-dialing steps managed to bank
+LOCAL_STEPS = frozenset({"trace_analyze", "perf_fill"})
+
+
 def run_battery(tag: str, stub: bool, no_commit: bool,
-                stage: int = 0, rehearse: bool = False) -> dict:
+                stage: int = 0, rehearse: bool = False,
+                probe_timeout: float = 150.0,
+                stub_probe: str | None = None) -> dict:
     os.makedirs(MEASURED, exist_ok=True)
     logdir = os.path.join(MEASURED, "logs")
     os.makedirs(logdir, exist_ok=True)
     results = {}
+    tunnel_dead = False
     if stub:
         steps = [("stub",
                   [sys.executable, "-c", "print('{\"stub\": true}')"],
@@ -264,6 +289,12 @@ def run_battery(tag: str, stub: bool, no_commit: bool,
     else:
         steps = _battery_steps(tag, stage)
     for name, argv, timeout_s, capture, extra_env in steps:
+        if tunnel_dead and name not in LOCAL_STEPS:
+            results[name] = {"rc": "skipped: tunnel unreachable",
+                             "seconds": 0.0}
+            print(f"hw_watch: battery step '{name}' -> {results[name]}",
+                  flush=True)
+            continue
         t0 = time.monotonic()
         log_path = os.path.join(logdir, f"{name}_{tag}.log")
         print(f"hw_watch: battery step '{name}' starting "
@@ -312,6 +343,33 @@ def run_battery(tag: str, stub: bool, no_commit: bool,
         except subprocess.TimeoutExpired:
             results[name] = {"rc": "timeout",
                              "seconds": round(time.monotonic() - t0, 1)}
+            print(f"hw_watch: battery step '{name}' -> {results[name]}",
+                  flush=True)
+            # A wedged tunnel-dialing step usually means the relay is
+            # jammed (or the tunnel dropped mid-battery).  Settle, then
+            # re-probe before dialing again; if the tunnel stays dead,
+            # skip the remaining tunnel-dialing steps (local ones — the
+            # trace analysis and the PERFORMANCE.md fill — still run on
+            # whatever was banked).  A timed-out LOCAL step implicates
+            # only itself: no settle, no probe.
+            if not (stub or rehearse) and name not in LOCAL_STEPS:
+                settle = float(os.environ.get(
+                    "BLUEFOG_HW_WATCH_SETTLE", "180"))
+                print(f"hw_watch: settling {settle:.0f}s, then re-probing "
+                      "the tunnel", flush=True)
+                time.sleep(settle)
+                pt0 = time.monotonic()
+                alive = probe(probe_timeout, stub_probe)
+                _bench.write_probe_state(
+                    alive, time.monotonic() - pt0, writer="hw_watch")
+                if not alive:
+                    tunnel_dead = True
+                    results["_battery"] = {"rc": f"aborted after {name}",
+                                           "seconds": 0.0}
+                    print("hw_watch: tunnel unreachable after timeout; "
+                          "skipping remaining tunnel-dialing steps",
+                          flush=True)
+            continue
         except Exception as e:                      # noqa: BLE001
             results[name] = {"rc": f"error: {e}"[:200],
                              "seconds": round(time.monotonic() - t0, 1)}
@@ -421,7 +479,9 @@ def main() -> int:
                     stage = batteries       # 0 = standard, 1+ = extended
                     batteries += 1
                     summary = run_battery(args.tag, args.stub_battery,
-                                          args.no_commit, stage=stage)
+                                          args.no_commit, stage=stage,
+                                          probe_timeout=args.probe_timeout,
+                                          stub_probe=args.stub_probe)
                     last_battery_end = time.monotonic()
                     log_probe(True, dt, note=f" battery={summary['steps']}")
             if args.once:

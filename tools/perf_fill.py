@@ -53,14 +53,16 @@ def render(tag):
             f"**{bench.get('value')} {bench.get('unit', '')}** | "
             f"MFU {_fmt_mfu(bench.get('mfu'))} | "
             f"vs V100 baseline x{bench.get('vs_baseline')} |")
-    if lm and lm.get("ok"):
-        cfg = lm.get("config", {})
-        acc = "TPU" if lm.get("on_accelerator") else "CPU FALLBACK"
-        rows.append(
-            f"| Transformer LM ring-SP ({acc}, L{cfg.get('layers')} "
-            f"d{cfg.get('d_model')} T{cfg.get('seq')}) | "
-            f"**{lm.get('value')} tok/s** | MFU {_fmt_mfu(lm.get('mfu'))} | "
-            f"pallas={cfg.get('use_pallas')} |")
+    for lm_rec in (lm, _load("lm_bench_pallas", tag)):
+        if lm_rec and lm_rec.get("ok"):
+            cfg = lm_rec.get("config", {})
+            acc = "TPU" if lm_rec.get("on_accelerator") else "CPU FALLBACK"
+            rows.append(
+                f"| Transformer LM ring-SP ({acc}, L{cfg.get('layers')} "
+                f"d{cfg.get('d_model')} T{cfg.get('seq')}) | "
+                f"**{lm_rec.get('value')} tok/s** | "
+                f"MFU {_fmt_mfu(lm_rec.get('mfu'))} | "
+                f"pallas={cfg.get('use_pallas')} |")
     if rows:
         lines += ["| benchmark | throughput | MFU | note |",
                   "|---|---|---|---|", *rows, ""]

@@ -9,24 +9,41 @@ them against the pure-XLA (jnp) formulation.
 Run (needs the TPU tunnel, single client):  python tools/tpu_validate.py
 
 Prints one JSON line per check: {"check", "ok", ...details}.
+
+Isolation (default): each check group runs in its OWN subprocess with a
+per-group timeout.  A remote Mosaic compile can wedge the axon tunnel
+indefinitely (round 5: the whole script froze on its first kernel and
+burned the battery step's full 3600 s); isolation converts that into one
+lost group.  After any group timeout the parent re-probes the tunnel and
+aborts the remaining groups if it stays unreachable — partial results
+still land in ``--out``.  ``--inline`` restores the single-process mode.
 """
+import argparse
 import json
 import os
+import subprocess
 import sys
 import time
 
-import jax
-import jax.numpy as jnp
-import numpy as np
-
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_HERE))
 sys.path.insert(0, ".")
-from bluefog_tpu.api import hard_sync  # noqa: E402
-from bluefog_tpu.ops import pallas_attention as pa  # noqa: E402
-from bluefog_tpu.utils.config import enable_compilation_cache  # noqa: E402
-
-enable_compilation_cache()
 
 RESULTS = []
+
+
+def _load_heavy():
+    """Import the jax stack only where it is used: the isolated-mode
+    parent must stay un-wedgeable (and fast to start), so only the
+    ``--inline`` children pay for — and risk — loading the axon-plugin-
+    bearing jax stack and the kernel modules."""
+    global jax, jnp, np, pa, hard_sync, enable_compilation_cache
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from bluefog_tpu.api import hard_sync
+    from bluefog_tpu.ops import pallas_attention as pa
+    from bluefog_tpu.utils.config import enable_compilation_cache
 
 
 def report(check, ok, **extra):
@@ -218,39 +235,192 @@ def check_ring_single_device():
         bf.shutdown()
 
 
-def main():
-    out_path = None
-    for i, a in enumerate(sys.argv):
-        if a == "--out" and i + 1 < len(sys.argv):
-            out_path = sys.argv[i + 1]
+# MXU-aligned shapes; 768 exercises the q-block padding path (advisor fix)
+GROUPS = {
+    "fwd_1k": lambda: check_forward(2, 1024, 4, 128, causal=True,
+                                    block_q=512, tag="1k_causal"),
+    "fwd_768": lambda: check_forward(2, 768, 4, 128, causal=False,
+                                     block_q=512, tag="768_pad"),
+    "bwd_512": lambda: check_backward(1, 512, 4, 128, causal=True,
+                                      block_q=256, tag="512_causal"),
+    "bwd_384": lambda: check_backward(1, 384, 2, 64, causal=False,
+                                      block_q=256, tag="384_pad"),
+    "timing": lambda: bench_kernel(4, 2048, 8, 128, block_q=512),
+    "ring": check_ring_single_device,
+}
 
+
+def _cpu_pinned() -> bool:
+    return os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu"
+
+
+def _run_groups_inline(names) -> str:
+    """Dial the accelerator and run the named groups in THIS process.
+    Returns the device kind (exits 2 when only a CPU is available)."""
+    _load_heavy()
     # honor an explicit CPU pin: the axon plugin force-overrides the
     # JAX_PLATFORMS env var at boot, so without this a CPU-pinned run
     # (battery rehearsal, CI) dials the TPU tunnel just to refuse
-    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+    if _cpu_pinned():
         jax.config.update("jax_platforms", "cpu")
     dev = jax.devices()[0]
     if dev.platform == "cpu":
         print("refusing: no accelerator", file=sys.stderr)
         sys.exit(2)
+    enable_compilation_cache()
     report("device", True, kind=dev.device_kind, platform=dev.platform)
+    for n in names:
+        GROUPS[n]()
+    return dev.device_kind
 
-    # MXU-aligned shapes; 768 exercises the q-block padding path (advisor fix)
-    check_forward(2, 1024, 4, 128, causal=True, block_q=512, tag="1k_causal")
-    check_forward(2, 768, 4, 128, causal=False, block_q=512, tag="768_pad")
-    check_backward(1, 512, 4, 128, causal=True, block_q=256, tag="512_causal")
-    check_backward(1, 384, 2, 64, causal=False, block_q=256, tag="384_pad")
-    bench_kernel(4, 2048, 8, 128, block_q=512)
-    check_ring_single_device()
+
+def _probe_alive(timeout_s: float) -> bool:
+    """Re-probe the tunnel from a fresh subprocess (bench._probe owns the
+    probe command + kill loop); records the outcome in the shared state
+    file so a dead tunnel also shortens later bench.py probing."""
+    import bench as _bench
+    t0 = time.monotonic()
+    ok = _bench._probe(dict(os.environ), timeout_s)
+    _bench.write_probe_state(ok, time.monotonic() - t0,
+                             writer="tpu_validate")
+    return ok
+
+
+def _write_out(out_path, device) -> None:
+    """Persist whatever has landed so far: an outer kill (the battery's
+    step timeout) must not erase completed groups' results."""
+    if not out_path:
+        return
+    ok = all(r["ok"] for r in RESULTS)
+    os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
+    with open(out_path, "w") as f:
+        json.dump({"device": device, "results": RESULTS,
+                   "summary": "PASS" if ok else "FAIL",
+                   "n_checks": len(RESULTS)}, f, indent=1)
+
+
+def _run_isolated(args, names) -> str:
+    """One subprocess per group, each under ``--group-timeout`` and a
+    total ``--budget``; after a timeout, settle + re-probe before dialing
+    again (a killed client can leave the single-client axon relay
+    jammed).  ``--out`` is rewritten after every group."""
+    device = "unknown"
+    device_reported = False
+    start = time.monotonic()
+    pending = list(names)
+    # a wedged group costs settle + probe on top of its timeout; reserve
+    # that headroom so the WHOLE worst case stays inside --budget (which
+    # in turn sits under the caller's step timeout — partial results must
+    # be written by this process, not lost to an outer kill)
+    recovery = args.settle_s + args.probe_timeout
+    while pending:
+        name = pending.pop(0)
+        usable = (args.budget - (time.monotonic() - start)
+                  - (recovery if pending else 0.0))
+        if usable < 60.0:
+            report(f"group_{name}", False, error="skipped: budget exhausted")
+            continue
+        argv = [sys.executable, os.path.abspath(__file__), "--inline",
+                "--only", name]
+        t0 = time.monotonic()
+        # children share this process group on purpose: an outer killpg
+        # aimed at this parent (hw_watch's battery-step timeout) must take
+        # the in-flight tunnel dialer down with it.  Groups spawn no
+        # grandchildren, so p.kill() suffices for the per-group timeout.
+        p = subprocess.Popen(argv, cwd=os.path.dirname(_HERE), text=True,
+                             stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+        try:
+            out, err = p.communicate(
+                timeout=min(args.group_timeout, usable))
+        except subprocess.TimeoutExpired:
+            p.kill()
+            p.wait()
+            report(f"group_{name}", False, error="timeout",
+                   seconds=round(time.monotonic() - t0, 1))
+            _write_out(args.out, device)
+            if pending:
+                print(f"validate: group '{name}' wedged; settling "
+                      f"{args.settle_s:.0f}s then re-probing the tunnel",
+                      file=sys.stderr, flush=True)
+                time.sleep(args.settle_s)
+                if not _probe_alive(args.probe_timeout):
+                    for rest in pending:
+                        report(f"group_{rest}", False,
+                               error="skipped: tunnel unreachable")
+                    pending = []
+            continue
+        if err.strip():
+            sys.stderr.write(err)
+        for ln in out.splitlines():
+            ln = ln.strip()
+            if not ln:
+                continue
+            try:
+                rec = json.loads(ln)
+            except ValueError:
+                continue
+            if rec.get("check") == "device":
+                device = rec.get("kind", device)
+                if not device_reported:       # echo the device line once
+                    device_reported = True
+                    RESULTS.append(rec)
+                    print(json.dumps(rec), flush=True)
+            elif "check" in rec:
+                RESULTS.append(rec)
+                print(json.dumps(rec), flush=True)
+        if p.returncode == 2:
+            if not RESULTS:
+                # nothing ran yet and there is no accelerator: refuse
+                # like the inline mode (any prior record — even a
+                # timeout — means the tunnel WAS being dialed, so fall
+                # through to the vanished-mid-run branch instead)
+                print("refusing: no accelerator", file=sys.stderr)
+                sys.exit(2)
+            # the tunnel served earlier groups but now exposes no TPU
+            # (daemon restart): keep the banked results, record the loss
+            report(f"group_{name}", False,
+                   error="accelerator vanished mid-run (exit 2)")
+        elif p.returncode not in (0, 1):    # crash without JSON output
+            report(f"group_{name}", False,
+                   error=f"exit {p.returncode}",
+                   seconds=round(time.monotonic() - t0, 1))
+        _write_out(args.out, device)
+    return device
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=None)
+    ap.add_argument("--inline", action="store_true",
+                    help="single-process mode (no per-group isolation)")
+    ap.add_argument("--only", action="append", choices=sorted(GROUPS),
+                    help="run only these groups (repeatable)")
+    ap.add_argument("--group-timeout", type=float, default=900.0,
+                    help="per-group subprocess timeout (isolated mode)")
+    ap.add_argument("--budget", type=float, default=2700.0,
+                    help="total wall-clock budget for all groups; must sit "
+                         "under the caller's own step timeout so partial "
+                         "results are written by THIS process, not lost "
+                         "to an outer kill")
+    ap.add_argument("--settle-s", type=float, default=150.0,
+                    help="quiet period after a wedged group before the "
+                         "re-probe dials the relay again")
+    ap.add_argument("--probe-timeout", type=float, default=150.0)
+    args = ap.parse_args()
+
+    names = args.only or list(GROUPS)
+    if args.inline:
+        device = _run_groups_inline(names)
+    else:
+        if _cpu_pinned():                   # refuse without spawning
+            print("refusing: no accelerator", file=sys.stderr)
+            sys.exit(2)
+        device = _run_isolated(args, names)
 
     ok = all(r["ok"] for r in RESULTS)
-    summary = {"summary": "PASS" if ok else "FAIL", "n_checks": len(RESULTS)}
-    print(json.dumps(summary))
-    if out_path:
-        os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
-        with open(out_path, "w") as f:
-            json.dump({"device": dev.device_kind, "results": RESULTS,
-                       **summary}, f, indent=1)
+    print(json.dumps({"summary": "PASS" if ok else "FAIL",
+                      "n_checks": len(RESULTS)}))
+    _write_out(args.out, device)
     sys.exit(0 if ok else 1)
 
 

@@ -163,7 +163,9 @@ def test_extended_battery_step_configs():
     bench = next(s for s in steps if s[0] == "bench_big")
     assert bench[4]["BLUEFOG_BENCH_BATCH"] == "128"
     assert any("bench_rTx.json" in str(a) for a in bench[3:4])
-    lm = next(s for s in steps if s[0] == "lm_bench_long")
+    # named after the artifact it writes (lm_bench_pallas_<tag>x.json):
+    # cross-round battery summaries must not reuse one label for two kernels
+    lm = next(s for s in steps if s[0] == "lm_bench_long_pallas")
     assert "8192" in lm[1]
 
 
@@ -177,8 +179,10 @@ def test_rehearsal_steps_are_cpu_safe():
     spec.loader.exec_module(mod)
     steps = mod._rehearsal_steps("rT-rehearsal")
     names = [s[0] for s in steps]
-    assert names == ["bench", "tpu_validate", "chip_calibrate",
-                     "step_sweep", "lm_bench", "trace_analyze", "perf_fill"]
+    # rehearsal must mirror the REAL battery's sequencing (stage 0 of
+    # _battery_steps) so it validates the order the hardware window runs
+    real = [s[0] for s in mod._battery_steps("rT")]
+    assert names == real
     for name, argv, _timeout, _cap, env in steps:
         cpu_safe = ((env or {}).get("JAX_PLATFORMS") == "cpu"
                     or (env or {}).get("BLUEFOG_BENCH_FORCE_CPU") == "1"
@@ -197,16 +201,19 @@ def test_battery_resolves_steps_at_fire_time(paths):
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     names = [s[0] for s in mod._battery_steps("x")]
-    # pure-XLA measurements land before the Pallas-compiling steps: a
-    # wedged Mosaic compile must not cost the calibrate/sweep/LM numbers
-    assert names[:3] == ["bench", "chip_calibrate", "step_sweep"]
+    # cheapest-per-artifact first (headline bench, 30 s calibrate, the LM
+    # rows), the long multi-compile sweep after them, Mosaic-heavy
+    # validate last: a short tunnel window must bank the most artifacts
+    assert names[:2] == ["bench", "chip_calibrate"]
+    assert names.index("chip_calibrate") < names.index("step_sweep")
+    assert names.index("step_sweep") < names.index("tpu_validate")
     for optional in ("lm_bench", "trace_analyze", "perf_fill"):
         tool = os.path.join(REPO, "tools", f"{optional}.py")
         assert (optional in names) == os.path.exists(tool)
     if "lm_bench" in names:     # XLA LM first, pallas variant after,
-        assert (names.index("lm_bench")          # validate last of the
-                < names.index("lm_bench_pallas")  # tunnel-dialing steps
-                < names.index("tpu_validate"))
+        assert (names.index("lm_bench")          # both before the sweep
+                < names.index("lm_bench_pallas")
+                < names.index("step_sweep"))
 
 
 def test_battery_aborts_when_tunnel_dies_mid_run(paths, monkeypatch, tmp_path):

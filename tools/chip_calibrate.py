@@ -33,6 +33,18 @@ from bluefog_tpu.api import hard_sync  # noqa: E402
 from bluefog_tpu.utils.config import enable_compilation_cache  # noqa: E402
 
 
+def _spec_peak_tflops(device_kind: str):
+    """Dense bf16 spec-sheet peak for the attached chip (bench.PEAK_FLOPS
+    is the single source; bench.py's top level is stdlib-only so the
+    import is side-effect free)."""
+    import os
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), os.pardir))
+    import bench
+    peak = bench._peak_flops(device_kind)
+    return peak / 1e12 if peak else None
+
+
 def _timed(f, x):
     """Seconds for one dispatch of compiled ``f`` (hard_sync barrier)."""
     t0 = time.perf_counter()
@@ -73,18 +85,58 @@ def main():
 
     mm_sizes = (256,) if smoke else (4096, 8192)
     iters = 5 if smoke else 50
+    peak = _spec_peak_tflops(d.device_kind)
+    mm_rows = []
     for n in mm_sizes:
-        # rows of a sum to 1 => the scan carry stays O(1) (no bf16 overflow
-        # across 50 chained matmuls)
-        a = jnp.full((n, n), 1.0 / n, jnp.bfloat16)
+        # random ROW-STOCHASTIC operand: rows sum to 1, so the scan carry
+        # stays O(1) across 50 chained matmuls — and, unlike the obvious
+        # jnp.full(1/n) splat, it is not a broadcast-of-scalar that XLA's
+        # algebraic simplifier rewrites into an O(n^2) column reduction
+        # (that rewrite once reported an impossible 641 TF/s here on a
+        # 197 TF/s chip: the "matmul" never touched the MXU)
+        a = jax.random.uniform(jax.random.key(n), (n, n), jnp.float32,
+                               0.5, 1.5)
+        a = (a / a.sum(axis=1, keepdims=True)).astype(jnp.bfloat16)
         per_scan = _scanned(lambda c: a @ c, a, iters)
         per_call = _dispatched(lambda c: a @ c, a, iters)
-        print(json.dumps({
+        tflops = 2 * n ** 3 / per_scan / 1e12
+        row = {
             "probe": f"matmul_bf16_{n}",
             "ms": round(per_scan * 1e3, 3),
-            "tflops": round(2 * n ** 3 / per_scan / 1e12, 1),
+            "tflops": round(tflops, 1),
             "per_dispatch_ms": round(per_call * 1e3, 3),
-            "dispatch_overhead_ms": round((per_call - per_scan) * 1e3, 3)}))
+            "dispatch_overhead_ms": round((per_call - per_scan) * 1e3, 3)}
+        if peak:
+            row["spec_peak_tflops"] = round(peak, 1)
+            # a rate above the spec sheet means the MEASUREMENT is broken
+            # (folded operand or a sync barrier that returned at dispatch),
+            # never that the chip overachieved — flag it loudly
+            if tflops > peak:
+                row["suspect"] = True
+                row["note"] = (f"{tflops:.1f} TF/s exceeds the "
+                               f"{peak:.0f} TF/s spec peak: the operand was "
+                               "folded or the sync barrier returned early")
+        mm_rows.append(row)
+    # structural cross-check BEFORE printing: a real n^3 matmul takes ~8x
+    # longer at 2n.  A folded operand (O(n^2) reduction) or broken barrier
+    # flattens the ratio — the pre-fix splat showed 8192 at 1.04x the 4096
+    # time while the 4096 rate sat BELOW peak, which the above-peak check
+    # alone misses.
+    if len(mm_rows) == 2:
+        ratio = mm_rows[1]["ms"] / max(mm_rows[0]["ms"], 1e-9)
+        if ratio < 4.0:
+            msg = (f"time({mm_sizes[1]})/time({mm_sizes[0]}) "
+                   f"= {ratio:.2f}x, expected ~8x for a real "
+                   "O(n^3) matmul: operand folding or "
+                   "early-return barrier")
+            for row in mm_rows:
+                row["suspect"] = True
+                # append: an above-peak diagnosis already in the note is
+                # the stronger evidence and must survive
+                row["note"] = (row["note"] + "; " + msg
+                               if row.get("note") else msg)
+    for row in mm_rows:
+        print(json.dumps(row))
 
     hbm_sizes = (2 ** 20,) if smoke else (2 ** 27, 2 ** 28)   # 512MiB, 1GiB
     for size in hbm_sizes:

@@ -10,10 +10,11 @@ to shorten its own probing after known-recent failures).  On the first
 successful probe it runs the full measurement battery unattended, in order:
 
     bench.py                                 → docs/measured/bench_<tag>.json
-    tools/tpu_validate.py --out …            → tpu_validate_<tag>.json
     tools/chip_calibrate.py                  → chip_calibrate_<tag>.json
+    tools/lm_bench.py --out …                → lm_bench[_pallas]_<tag>.json
     tools/step_sweep.py --out … --trace …    → step_sweep_<tag>.json + trace
-    tools/lm_bench.py --out …                → lm_bench_<tag>.json   (if present)
+    tools/tpu_validate.py --out …            → tpu_validate_<tag>.json  (LAST:
+                                               Mosaic compiles can wedge the relay)
     tools/trace_analyze.py …                 → trace_split_<tag>.json (if present)
     tools/perf_fill.py --tag <tag>           → PERFORMANCE.md headline (if present)
 
@@ -156,7 +157,7 @@ def _battery_steps(tag: str, stage: int = 0) -> list:
             # score tensor — ~34 GB at batch 8 against 16 GB of HBM.
             # Flash (O(block_q) VMEM) is the long-context story anyway;
             # the XLA-attention row is banked at 4096 by stage 0.
-            steps.append(("lm_bench_long",
+            steps.append(("lm_bench_long_pallas",
                           [py, lm, "--seq", "8192", "--batch", "8",
                            "--out",
                            os.path.join(m, f"lm_bench_pallas_{tag}.json")],
@@ -168,21 +169,20 @@ def _battery_steps(tag: str, stage: int = 0) -> list:
                            os.path.join(m, f"trace_split_{tag}.json")],
                           600, None, None))
         return steps
-    # Pure-XLA measurements first, Pallas last: a remote Mosaic compile
-    # can wedge the axon tunnel (round 5: tpu_validate froze on its first
-    # kernel and ate its whole 3600 s budget while calibrate/sweep/LM
-    # numbers were still unbanked).  The post-timeout probe in
-    # run_battery stops a dead tunnel from burning the remaining steps.
+    # Ordering under SHORT windows (round 5 measured one at ~7 minutes:
+    # probe ok 06:27, tunnel dead 06:34 with step_sweep wedged mid-run):
+    # cheapest-per-artifact first — bench (the headline), calibrate (30 s
+    # cached), the two LM rows — then the long multi-compile sweep, and
+    # the Mosaic-heavy tpu_validate last (a remote Mosaic compile can
+    # wedge the relay; round 5 lost a whole window to it when it ran
+    # second).  The post-timeout probe in run_battery stops a dead
+    # tunnel from burning the remaining steps.
     steps = [
         ("bench", [py, os.path.join(REPO, "bench.py")], 3600,
          os.path.join(m, f"bench_{tag}.json"), None),
         ("chip_calibrate",
          [py, os.path.join(REPO, "tools", "chip_calibrate.py")], 2400,
          os.path.join(m, f"chip_calibrate_{tag}.json"), None),
-        ("step_sweep",
-         [py, os.path.join(REPO, "tools", "step_sweep.py"),
-          "--out", os.path.join(m, f"step_sweep_{tag}.json"),
-          "--trace", os.path.join(m, f"trace_{tag}")], 5400, None, None),
     ]
     if os.path.exists(lm):
         # batch 2: the XLA (non-flash) attention materializes [B,T,H,T]
@@ -198,6 +198,14 @@ def _battery_steps(tag: str, stage: int = 0) -> list:
                       [py, lm, "--out",
                        os.path.join(m, f"lm_bench_pallas_{tag}.json")],
                       2400, None, None))
+    # 1,5,10 not 1,2,5,10: one fewer ResNet compile (~5 min of window)
+    # and k=2 adds nothing the amortization curve needs
+    steps.append(("step_sweep",
+                  [py, os.path.join(REPO, "tools", "step_sweep.py"),
+                   "--sweep", "1,5,10",
+                   "--out", os.path.join(m, f"step_sweep_{tag}.json"),
+                   "--trace", os.path.join(m, f"trace_{tag}")], 3600,
+                  None, None))
     steps.append(("tpu_validate",
                   [py, os.path.join(REPO, "tools", "tpu_validate.py"),
                    "--out", os.path.join(m, f"tpu_validate_{tag}.json")],
@@ -224,27 +232,36 @@ def _rehearsal_steps(tag: str) -> list:
                  "BLUEFOG_BENCH_IMAGE_SIZE": "32",
                  "BLUEFOG_BENCH_CLASSES": "10",
                  "BLUEFOG_COMPILE_CACHE": "off"}
+    # SAME ordering as _battery_steps stage 0 (bench, calibrate, the two
+    # LM rows, sweep, validate, then the local analysis/fill steps): the
+    # rehearsal's whole value is validating the sequencing + capture
+    # pipeline the real battery will run in the one-shot hardware window
     return [
         ("bench", [py, os.path.join(REPO, "bench.py")], 900,
          os.path.join(m, f"bench_{tag}.json"), smoke_env),
-        ("tpu_validate",
-         [py, os.path.join(REPO, "tools", "tpu_validate.py"),
-          "--out", os.path.join(m, f"tpu_validate_{tag}.json")],
-         300, None, {"JAX_PLATFORMS": "cpu"}),
         ("chip_calibrate",
          [py, os.path.join(REPO, "tools", "chip_calibrate.py"), "--smoke"],
          600, os.path.join(m, f"chip_calibrate_{tag}.json"), None),
+        ("lm_bench",
+         [py, os.path.join(REPO, "tools", "lm_bench.py"),
+          "--virtual-cpu", "--smoke", "--no-pallas",
+          "--out", os.path.join(m, f"lm_bench_{tag}.json")], 900, None,
+         None),
+        ("lm_bench_pallas",
+         [py, os.path.join(REPO, "tools", "lm_bench.py"),
+          "--virtual-cpu", "--smoke",
+          "--out", os.path.join(m, f"lm_bench_pallas_{tag}.json")], 900,
+         None, None),
         ("step_sweep",
          [py, os.path.join(REPO, "tools", "step_sweep.py"),
           "--sweep", "1,2", "--batch", "1", "--iters", "1", "--allow-cpu",
           "--out", os.path.join(m, f"step_sweep_{tag}.json"),
           "--trace", os.path.join(m, f"trace_{tag}")], 1200, None,
          smoke_env),
-        ("lm_bench",
-         [py, os.path.join(REPO, "tools", "lm_bench.py"),
-          "--virtual-cpu", "--smoke",
-          "--out", os.path.join(m, f"lm_bench_{tag}.json")], 900, None,
-         None),
+        ("tpu_validate",
+         [py, os.path.join(REPO, "tools", "tpu_validate.py"),
+          "--out", os.path.join(m, f"tpu_validate_{tag}.json")],
+         300, None, {"JAX_PLATFORMS": "cpu"}),
         ("trace_analyze",
          [py, os.path.join(REPO, "tools", "trace_analyze.py"),
           os.path.join(m, f"trace_{tag}"),
@@ -267,6 +284,26 @@ def _bench_env() -> dict:
     env.setdefault("BLUEFOG_BENCH_PROBE_TIMEOUT", "240")
     env.setdefault("BLUEFOG_BENCH_PROBE_SLEEP", "20")
     return env
+
+
+def _is_cpu_payload(payload):
+    """True if a captured artifact was measured on CPU, False if on an
+    accelerator, None when the payload doesn't say.  bench/lm_bench emit a
+    dict with ``on_accelerator``; chip_calibrate emits a LIST whose device
+    row carries ``platform`` — both must be covered or the anti-clobber
+    guard misses the list-shaped artifacts."""
+    if isinstance(payload, dict):
+        if "on_accelerator" in payload:
+            return not payload["on_accelerator"]
+        if "platform" in payload:
+            return payload["platform"] == "cpu"
+        return None
+    if isinstance(payload, list):
+        for row in payload:
+            flag = _is_cpu_payload(row)
+            if flag is not None:
+                return flag
+    return None
 
 
 # battery steps that never dial the tunnel (they only read local
@@ -340,9 +377,20 @@ def run_battery(tag: str, stub: bool, no_commit: bool,
                     except ValueError:
                         pass
                 if docs:
+                    payload = docs[-1] if len(docs) == 1 else docs
+                    # never clobber a banked on-TPU artifact with a CPU
+                    # fallback (tunnel died between the watcher's probe
+                    # and the child's own): divert to a sidecar instead
+                    if _is_cpu_payload(payload):
+                        try:
+                            with open(capture) as f:
+                                prev = json.load(f)
+                            if _is_cpu_payload(prev) is False:
+                                capture += ".cpu_fallback"
+                        except (OSError, ValueError):
+                            pass
                     with open(capture, "w") as f:
-                        json.dump(docs[-1] if len(docs) == 1 else docs,
-                                  f, indent=1)
+                        json.dump(payload, f, indent=1)
             results[name] = {"rc": p.returncode,
                              "seconds": round(time.monotonic() - t0, 1)}
         except subprocess.TimeoutExpired:

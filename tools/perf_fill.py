@@ -79,9 +79,14 @@ def render(tag):
                     continue
                 extra = (f"{e['tflops']} TFLOP/s" if "tflops" in e
                          else f"{e.get('gbps')} GB/s")
+                flag = ""
+                if e.get("suspect"):
+                    flag = " — **SUSPECT, not a ceiling**"
+                    if e.get("note"):
+                        flag += " (see artifact note)"
                 lines.append(
                     f"- `{e['probe']}`: {extra}, dispatch overhead "
-                    f"{e.get('dispatch_overhead_ms', '?')} ms")
+                    f"{e.get('dispatch_overhead_ms', '?')} ms{flag}")
             lines.append("")
 
     sweep = _load("step_sweep", tag)

@@ -145,6 +145,13 @@ def _battery_steps(tag: str, stage: int = 0) -> list:
              os.path.join(m, f"bench_{tag}.json"),
              {"BLUEFOG_BENCH_BATCH": "128", "BLUEFOG_BENCH_ITERS": "20",
               "BLUEFOG_BENCH_STEPS_PER_CALL": "10"}),
+            # batch-scaling point: if 256 wins on img/s+MFU it becomes the
+            # recommended headline config (ResNet-50 bf16 activations at
+            # 256x224^2 fit comfortably in 16 GB HBM)
+            ("bench_b256", [py, os.path.join(REPO, "bench.py")], 3600,
+             os.path.join(m, f"bench_b256_{tag}.json"),
+             {"BLUEFOG_BENCH_BATCH": "256", "BLUEFOG_BENCH_ITERS": "20",
+              "BLUEFOG_BENCH_STEPS_PER_CALL": "10"}),
             ("step_sweep_wide",
              [py, os.path.join(REPO, "tools", "step_sweep.py"),
               "--sweep", "1,2,5,10,20", "--batch", "128",

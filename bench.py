@@ -44,24 +44,43 @@ import time
 
 BASELINE_PER_GPU = 4310.6 / 16  # reference: img/sec per V100, 16-GPU run
 
-# Peak dense bf16 FLOP/s per chip by device kind (public spec sheets);
-# used for the MFU denominator.  Substring-matched against device_kind.
-PEAK_FLOPS = {
-    "v6": 918e12,          # Trillium / v6e
+# Chip spec tables (public spec sheets), substring-matched against
+# device_kind — longer keys first so "v5p" wins over "v5".  Single source
+# for every tool that needs a spec denominator (bench MFU, lm_bench,
+# chip_calibrate's above-peak tripwires).
+PEAK_FLOPS = {               # dense bf16 FLOP/s per chip
+    "v6": 918e12,            # Trillium / v6e
     "v5p": 459e12,
-    "v5": 197e12,          # v5e / "TPU v5 lite"
+    "v5": 197e12,            # v5e / "TPU v5 lite"
     "v4": 275e12,
     "v3": 123e12,
     "v2": 45e12,
 }
 
+HBM_PEAK_GBPS = {            # HBM bandwidth, GB/s per chip
+    "v6": 1640,              # Trillium / v6e
+    "v5p": 2765,
+    "v5": 819,               # v5e / "TPU v5 lite"
+    "v4": 1228,
+    "v3": 900,
+    "v2": 700,
+}
 
-def _peak_flops(device_kind: str):
+
+def _match_spec(device_kind: str, table: dict):
     kind = device_kind.lower()
-    for key, peak in PEAK_FLOPS.items():
+    for key, peak in table.items():
         if key in kind:
             return peak
     return None
+
+
+def _peak_flops(device_kind: str):
+    return _match_spec(device_kind, PEAK_FLOPS)
+
+
+def _peak_hbm_gbps(device_kind: str):
+    return _match_spec(device_kind, HBM_PEAK_GBPS)
 
 
 def _env_int(name, default):

@@ -194,6 +194,54 @@ def test_rehearsal_steps_are_cpu_safe():
     assert "--dry-run" in pf[1]
 
 
+def _load_watch(paths=None, monkeypatch=None, name="hw_watch_mod"):
+    if monkeypatch and paths:
+        for k, v in paths.items():
+            monkeypatch.setenv(k, v)
+    spec = importlib.util.spec_from_file_location(name, WATCH)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_is_cpu_payload_classification():
+    """The anti-clobber guard must read both artifact shapes: bench/lm
+    dicts (on_accelerator) and chip_calibrate row lists (platform)."""
+    mod = _load_watch()
+    f = mod._is_cpu_payload
+    assert f({"on_accelerator": False}) is True
+    assert f({"on_accelerator": True}) is False
+    assert f([{"probe": "device", "platform": "cpu"}, {"probe": "x"}]) is True
+    assert f([{"probe": "device", "platform": "tpu"}]) is False
+    assert f({"stub": True}) is None              # says nothing either way
+    assert f([{"probe": "x"}]) is None
+
+
+def test_capture_diverts_cpu_fallback_over_banked_tpu(paths, monkeypatch):
+    """Tunnel dies between the watcher's probe and a battery child's own:
+    the child's CPU line must land in a .cpu_fallback sidecar, never over
+    the banked on-TPU artifact."""
+    mod = _load_watch(paths, monkeypatch, name="hw_watch_clobber")
+    os.makedirs(mod.MEASURED, exist_ok=True)
+    banked = os.path.join(mod.MEASURED, "bench_rC.json")
+    with open(banked, "w") as f:
+        json.dump({"value": 1961.25, "on_accelerator": True}, f)
+    real_steps = mod._battery_steps
+    cpu_line = json.dumps({"value": 1.3, "on_accelerator": False})
+    mod._battery_steps = lambda tag, stage=0: [
+        ("bench", [sys.executable, "-c", f"print('{cpu_line}')"],
+         60, banked, None)]
+    try:
+        summary = mod.run_battery("rC", stub=False, no_commit=True)
+    finally:
+        mod._battery_steps = real_steps
+    assert summary["steps"]["bench"]["rc"] == 0
+    with open(banked) as f:
+        assert json.load(f)["on_accelerator"] is True     # untouched
+    with open(banked + ".cpu_fallback") as f:
+        assert json.load(f)["on_accelerator"] is False    # diverted
+
+
 def test_battery_resolves_steps_at_fire_time(paths):
     # the battery list must include lm_bench/trace_analyze/perf_fill only
     # when the files exist — resolved when the probe succeeds, not at start

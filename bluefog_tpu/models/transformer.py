@@ -13,7 +13,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from ..ops import ring_attention, ulysses_attention
+from ..ops import local_flash_attention, ring_attention, ulysses_attention
 from ..ops.ulysses import dense_attention
 
 
@@ -101,11 +101,25 @@ class RingTransformerBlock(nn.Module):
                     use_pallas=self.use_pallas,
                     pallas_interpret=self.pallas_interpret)
         else:
-            # single-device fallback: dense causal attention (expand GQA kv)
-            if Hkv != H:
-                k = jnp.repeat(k, H // Hkv, axis=2)
-                v = jnp.repeat(v, H // Hkv, axis=2)
-            att = dense_attention(q, k, v, causal=True).astype(self.dtype)
+            # single-device fallback (expand GQA kv).  use_pallas matters
+            # HERE too: dense_attention materializes the full [B,T,H,T]
+            # f32 score tensor (4.3 GB at batch 4 / seq 4096 / 16 heads),
+            # while the flash kernel keeps each [block_q, T] tile in VMEM
+            # and recomputes scores in the backward — on one chip it is
+            # the only way long sequences fit in HBM at all.
+            if self.use_pallas:
+                # compact GQA kv goes straight in (the kernel's index map
+                # routes q head h to kv head h//group); positional args:
+                # custom_vjp nondiff_argnums (causal, scale, block_q,
+                # interpret, axis)
+                att = local_flash_attention(
+                    q, k, v, True, Dh ** -0.5, 512,
+                    self.pallas_interpret, None).astype(self.dtype)
+            else:
+                if Hkv != H:            # dense oracle needs full-width kv
+                    k = jnp.repeat(k, H // Hkv, axis=2)
+                    v = jnp.repeat(v, H // Hkv, axis=2)
+                att = dense_attention(q, k, v, causal=True).astype(self.dtype)
         att = att.reshape(B, T, C)
         x = x + nn.Dense(C, use_bias=False, dtype=self.dtype)(att)
 

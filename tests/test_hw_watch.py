@@ -42,15 +42,21 @@ def _run(*args, paths, env=None):
         capture_output=True, text=True, timeout=120)
 
 
-def _load_bench(monkeypatch=None, paths=None):
+def _load_mod(path, name, monkeypatch=None, paths=None):
+    """Fresh module instance with the env overrides applied first (both
+    bench.py and hw_watch.py read their lock/state paths at import)."""
     if monkeypatch and paths:
         for k, v in paths.items():
             monkeypatch.setenv(k, v)
-    spec = importlib.util.spec_from_file_location(
-        "bench_for_test", os.path.join(REPO, "bench.py"))
+    spec = importlib.util.spec_from_file_location(name, path)
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     return mod
+
+
+def _load_bench(monkeypatch=None, paths=None):
+    return _load_mod(os.path.join(REPO, "bench.py"), "bench_for_test",
+                     monkeypatch, paths)
 
 
 def test_failed_probe_writes_state_and_log(paths, tmp_path):
@@ -195,13 +201,7 @@ def test_rehearsal_steps_are_cpu_safe():
 
 
 def _load_watch(paths=None, monkeypatch=None, name="hw_watch_mod"):
-    if monkeypatch and paths:
-        for k, v in paths.items():
-            monkeypatch.setenv(k, v)
-    spec = importlib.util.spec_from_file_location(name, WATCH)
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
-    return mod
+    return _load_mod(WATCH, name, monkeypatch, paths)
 
 
 def test_is_cpu_payload_classification():

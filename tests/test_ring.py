@@ -431,3 +431,38 @@ class TestSlidingWindow:
                 run(causal=True, window=4, layout="zigzag")
         finally:
             bf.shutdown()
+
+
+def test_single_device_lm_pallas_matches_dense():
+    """axis=None (one chip): use_pallas must actually engage the flash
+    kernel (interpret off-TPU) and match the dense fallback in forward
+    AND gradients.  Before round 5 the single-device branch silently
+    ignored use_pallas — the battery's 'pallas' LM row never ran Mosaic,
+    and long sequences OOMed in the dense [B,T,H,T] f32 scores."""
+    import bluefog_tpu.models as models
+
+    T = 64
+    tokens = jnp.asarray(
+        np.random.default_rng(3).integers(0, 31, (2, T)), jnp.int32)
+
+    outs, grads = {}, {}
+    for use_pallas in (False, True):
+        lm = models.RingTransformerLM(
+            vocab_size=31, num_layers=2, num_heads=4, d_model=32,
+            max_seq_len=T, axis=None, dtype=jnp.float32, rope=True,
+            use_pallas=use_pallas, pallas_interpret=True)
+        params = lm.init(jax.random.key(0), tokens)
+
+        def loss_fn(p, lm=lm):
+            logits = lm.apply(p, tokens, positions=jnp.arange(T))
+            return jnp.mean(logits.astype(jnp.float32) ** 2)
+
+        loss, g = jax.value_and_grad(loss_fn)(params)
+        outs[use_pallas] = float(loss)
+        grads[use_pallas] = g
+
+    np.testing.assert_allclose(outs[True], outs[False], rtol=2e-4)
+    for a, b in zip(jax.tree.leaves(grads[True]),
+                    jax.tree.leaves(grads[False])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-5)

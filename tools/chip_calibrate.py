@@ -38,8 +38,10 @@ def _bench_mod():
     tool's denominators); its top level is stdlib-only so the import is
     side-effect free."""
     import os
-    sys.path.insert(0, os.path.join(os.path.dirname(
-        os.path.abspath(__file__)), os.pardir))
+    repo = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        os.pardir)
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
     import bench
     return bench
 

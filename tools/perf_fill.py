@@ -91,9 +91,11 @@ def render(tag):
 
     sweep = _load("step_sweep", tag)
     if sweep and isinstance(sweep, dict) and sweep.get("rows"):
+        part = (" — **PARTIAL sweep** (tunnel died before all k values ran)"
+                if sweep.get("partial") else "")
         lines += [f"`steps_per_call` amortization (`tools/step_sweep.py`, "
                   f"batch {sweep.get('batch')}, best "
-                  f"x{sweep.get('dispatch_amortization')}):", ""]
+                  f"x{sweep.get('dispatch_amortization')}{part}):", ""]
         for p in sweep["rows"]:
             lines.append(f"- k={p['steps_per_call']}: "
                          f"{p.get('imgs_per_sec_per_chip')} img/s/chip "

@@ -20,6 +20,32 @@ import sys
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 
+def _summarize(device_kind, batch, rows, partial):
+    """vs_spc1/amortization from whatever rows exist so far (the k=1
+    baseline runs first in the sorted sweep) — single home for the
+    formula, shared by the stdout summary and the JSON artifact."""
+    base = rows[0]["imgs_per_sec_per_chip"]
+    rows = [dict(r, vs_spc1=round(r["imgs_per_sec_per_chip"] / base, 3))
+            for r in rows]
+    summary = {"device": device_kind, "batch": batch, "rows": rows,
+               "dispatch_amortization":
+                   round(max(r["imgs_per_sec_per_chip"] for r in rows)
+                         / base, 3)}
+    if partial:
+        summary["partial"] = True      # sweep did not finish all k values
+    return summary
+
+
+def _write_summary(out, device_kind, batch, rows, partial):
+    summary = _summarize(device_kind, batch, rows, partial)
+    os.makedirs(os.path.dirname(out) or ".", exist_ok=True)
+    tmp = f"{out}.tmp{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(summary, f, indent=1)
+    os.replace(tmp, out)
+    return summary
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--sweep", default="1,2,5,10",
@@ -63,30 +89,41 @@ def main():
         os.environ["BLUEFOG_BENCH_STEPS_PER_CALL"] = str(spc)
         tracing = args.trace and spc == max(sweep)
         if tracing:
-            jax.profiler.start_trace(args.trace)
+            # a profiler failure (the axon PJRT plugin may not support
+            # device tracing through the tunnel) must not cost the sweep
+            # rows themselves — they are the artifact; the trace is a bonus
+            try:
+                jax.profiler.start_trace(args.trace)
+            except Exception as e:          # noqa: BLE001
+                print(f"step_sweep: start_trace failed ({e}); continuing "
+                      "without a trace", file=sys.stderr)
+                tracing = False
         r = bench.run_bench(on_accel, {"sweep_index": i})
         if tracing:
-            jax.profiler.stop_trace()
+            try:
+                jax.profiler.stop_trace()
+            except Exception as e:          # noqa: BLE001
+                print(f"step_sweep: stop_trace failed ({e}); trace "
+                      "may be partial", file=sys.stderr)
         row = {"steps_per_call": spc, "imgs_per_sec_per_chip": r["value"],
                "mfu": r["mfu"]}
         rows.append(row)
         print(json.dumps(row), flush=True)
+        # bank INCREMENTALLY: a tunnel death mid-sweep (observed round 5)
+        # kills the process group and loses the stdout pipe — rows already
+        # measured must survive in the artifact.  partial is positional:
+        # only the LAST iteration's write claims a complete sweep.
+        if args.out:
+            summary = _write_summary(args.out, dev.device_kind, args.batch,
+                                     rows, partial=i != len(sweep) - 1)
 
-    base = rows[0]["imgs_per_sec_per_chip"]
-    for row in rows:
-        row["vs_spc1"] = round(row["imgs_per_sec_per_chip"] / base, 3)
-    summary = {"device": dev.device_kind, "batch": args.batch,
-               "rows": rows,
-               "dispatch_amortization":
-                   round(max(r["imgs_per_sec_per_chip"] for r in rows)
-                         / base, 3)}
+    if not args.out:
+        summary = _summarize(dev.device_kind, args.batch, rows,
+                             partial=False)
     print(json.dumps({"summary": summary["dispatch_amortization"],
-                      "best": max(rows,
+                      "best": max(summary["rows"],
                                   key=lambda r: r["imgs_per_sec_per_chip"])}))
     if args.out:
-        os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
-        with open(args.out, "w") as f:
-            json.dump(summary, f, indent=1)
         print(f"wrote {args.out}", file=sys.stderr)
 
 

@@ -52,6 +52,7 @@ class RingTransformerBlock(nn.Module):
     rope: bool = False                  # rotary positions on q/k
     use_pallas: bool = False            # VMEM flash kernel for the attention
     pallas_interpret: Optional[bool] = None   # override backend auto-detect
+    scan_compat: bool = False           # return (x, None) for nn.scan
 
     @nn.compact
     def __call__(self, x, positions=None):
@@ -127,7 +128,7 @@ class RingTransformerBlock(nn.Module):
         h = nn.Dense(self.mlp_ratio * C, dtype=self.dtype)(h)
         h = nn.gelu(h)
         x = x + nn.Dense(C, dtype=self.dtype)(h)
-        return x
+        return (x, None) if self.scan_compat else x
 
 
 class RingTransformerLM(nn.Module):
@@ -151,6 +152,13 @@ class RingTransformerLM(nn.Module):
     remat: bool = False     # rematerialize blocks: trade FLOPs for HBM
     use_pallas: bool = False
     pallas_interpret: Optional[bool] = None
+    scan_layers: bool = False   # lax.scan ONE block over depth: compile
+                                # time O(1) in num_layers (XLA compiles a
+                                # single block body instead of an unrolled
+                                # stack — minutes saved per TPU compile).
+                                # Params get a leading [num_layers] axis
+                                # under 'blocks' (different tree than the
+                                # unrolled loop's per-layer modules).
 
     @nn.compact
     def __call__(self, tokens, pos_offset=0, positions=None):
@@ -167,16 +175,33 @@ class RingTransformerLM(nn.Module):
             pos = nn.Embed(self.max_seq_len, self.d_model, dtype=self.dtype)(
                 positions)
             x = x + pos[None]
-        Block = (nn.remat(RingTransformerBlock,
-                          policy=jax.checkpoint_policies.nothing_saveable)
-                 if self.remat else RingTransformerBlock)
-        for _ in range(self.num_layers):
-            x = Block(
-                num_heads=self.num_heads, num_kv_heads=self.num_kv_heads,
-                axis=self.axis, dtype=self.dtype,
-                sp_mode=self.sp_mode, sp_layout=self.sp_layout,
-                rope=self.rope, use_pallas=self.use_pallas,
-                pallas_interpret=self.pallas_interpret)(x, positions)
+        if self.remat:
+            # prevent_cse only matters OUTSIDE lax.scan (scan already
+            # blocks the CSE it guards against); leaving it on inside the
+            # scanned stack litters every iteration with optimization
+            # barriers that inhibit fusion in the backward
+            Block = nn.remat(
+                RingTransformerBlock,
+                policy=jax.checkpoint_policies.nothing_saveable,
+                prevent_cse=not self.scan_layers)
+        else:
+            Block = RingTransformerBlock
+        kw = dict(
+            num_heads=self.num_heads, num_kv_heads=self.num_kv_heads,
+            axis=self.axis, dtype=self.dtype,
+            sp_mode=self.sp_mode, sp_layout=self.sp_layout,
+            rope=self.rope, use_pallas=self.use_pallas,
+            pallas_interpret=self.pallas_interpret)
+        if self.scan_layers:
+            ScanStack = nn.scan(
+                Block, variable_axes={"params": 0},
+                split_rngs={"params": True}, in_axes=nn.broadcast,
+                length=self.num_layers)
+            x, _ = ScanStack(**kw, scan_compat=True,
+                             name="blocks")(x, positions)
+        else:
+            for _ in range(self.num_layers):
+                x = Block(**kw)(x, positions)
         x = nn.LayerNorm(dtype=jnp.float32)(x)
         return nn.Dense(self.vocab_size, use_bias=False,
                         dtype=jnp.float32)(x)

@@ -60,6 +60,10 @@ def main():
                     help="ring layout when the mesh has >1 device")
     ap.add_argument("--no-pallas", action="store_true",
                     help="pure-XLA attention instead of the flash kernel")
+    ap.add_argument("--no-scan-layers", action="store_true",
+                    help="unrolled layer stack (default scans ONE block "
+                         "over depth: compile time O(1) in --layers, the "
+                         "scarce resource in a tunnel window)")
     ap.add_argument("--out", default=None, help="json artifact path")
     ap.add_argument("--allow-cpu", action="store_true")
     args = ap.parse_args()
@@ -116,8 +120,11 @@ def main():
         vocab_size=vocab, num_layers=layers, num_heads=heads,
         d_model=d_model, max_seq_len=seq, axis="rank" if n > 1 else None,
         dtype=jnp.bfloat16, sp_mode="ring", sp_layout=layout, rope=True,
-        use_pallas=use_pallas)
-    params = lm.clone(axis=None).init(
+        use_pallas=use_pallas, scan_layers=not args.no_scan_layers)
+    # init on the dense unparallel clone: the attention holds no params,
+    # and running the flash kernel eagerly here would burn a Mosaic
+    # compile (tunnel-minutes) on a shape-only computation
+    params = lm.clone(axis=None, use_pallas=False).init(
         jax.random.key(0), jnp.zeros((1, local_T), jnp.int32))
     n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
 
@@ -210,6 +217,7 @@ def main():
                    "heads": heads, "batch": batch, "vocab": vocab,
                    "n_params": n_params, "sp_layout": layout,
                    "use_pallas": use_pallas,
+                   "scan_layers": not args.no_scan_layers,
                    "steps_per_call": steps_per_call, "iters": iters},
         "flops_per_token": flops_per_token,
         "xla_call_flops": xla_call_flops,

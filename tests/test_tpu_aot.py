@@ -673,20 +673,27 @@ def test_win_put_wire_compresses_tpu_payload(tpu_mesh):
     assert not any(re.search(r"f32\[\d{4,}", lines[l]) for l in starts)
 
 
-def test_single_device_lm_pallas_lowers_for_tpu(tpu_mesh):
-    """The battery's Pallas LM row (tools/lm_bench.py on ONE chip:
-    RingTransformerLM with axis=None + use_pallas) fwd+bwd compiles
-    through Mosaic for v5e — proven here so the first real-hardware run
-    of local_flash_attention cannot die on a lowering bug mid-window.
-    Compiled replicated over the AOT mesh: no collectives, same local
-    program a single chip runs."""
+@pytest.mark.parametrize("scan_layers,remat", [
+    (False, False),       # stage-0 lm_bench_pallas default (pre-scan era)
+    (True, False),        # lm_bench default: scan_layers on
+    (True, True),         # stage-1 lm_bench_long_pallas: scan + remat
+])
+def test_single_device_lm_pallas_lowers_for_tpu(tpu_mesh, scan_layers,
+                                                remat):
+    """The battery's Pallas LM rows (tools/lm_bench.py on ONE chip:
+    RingTransformerLM with axis=None + use_pallas, scanned and/or
+    rematerialized) fwd+bwd compile through Mosaic for v5e — proven here
+    so the first real-hardware run of local_flash_attention cannot die
+    on a lowering bug mid-window.  Compiled replicated over the AOT
+    mesh: no collectives, same local program a single chip runs."""
     from bluefog_tpu import models
 
     T = 1024
     lm = models.RingTransformerLM(
         vocab_size=128, num_layers=2, num_heads=4, d_model=128,
         max_seq_len=T, axis=None, dtype=jnp.bfloat16, rope=True,
-        use_pallas=True, pallas_interpret=False)
+        use_pallas=True, pallas_interpret=False,
+        scan_layers=scan_layers, remat=remat)
     # init executes eagerly on the host CPU: use the dense clone (the
     # attention has no params, so the tree is identical) — the pallas lm
     # itself is only traced/lowered, never run here

@@ -164,9 +164,12 @@ def _battery_steps(tag: str, stage: int = 0) -> list:
             # score tensor — ~34 GB at batch 8 against 16 GB of HBM.
             # Flash (O(block_q) VMEM) is the long-context story anyway;
             # the XLA-attention row is banked at 4096 by stage 0.
+            # --remat: at 8192x8 the per-layer MLP/attention residuals
+            # (~1 GB/layer bf16) would not survive to the backward in
+            # 16 GB HBM; nothing_saveable keeps only layer inputs
             steps.append(("lm_bench_long_pallas",
                           [py, lm, "--seq", "8192", "--batch", "8",
-                           "--out",
+                           "--remat", "--out",
                            os.path.join(m, f"lm_bench_pallas_{tag}.json")],
                           3600, None, None))
         if os.path.exists(ta):

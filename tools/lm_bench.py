@@ -64,6 +64,11 @@ def main():
                     help="unrolled layer stack (default scans ONE block "
                          "over depth: compile time O(1) in --layers, the "
                          "scarce resource in a tunnel window)")
+    ap.add_argument("--remat", action="store_true",
+                    help="rematerialize blocks (nothing_saveable): only "
+                         "layer inputs survive to the backward — required "
+                         "for long-context configs whose per-layer "
+                         "residuals would not fit HBM")
     ap.add_argument("--out", default=None, help="json artifact path")
     ap.add_argument("--allow-cpu", action="store_true")
     args = ap.parse_args()
@@ -120,7 +125,8 @@ def main():
         vocab_size=vocab, num_layers=layers, num_heads=heads,
         d_model=d_model, max_seq_len=seq, axis="rank" if n > 1 else None,
         dtype=jnp.bfloat16, sp_mode="ring", sp_layout=layout, rope=True,
-        use_pallas=use_pallas, scan_layers=not args.no_scan_layers)
+        use_pallas=use_pallas, scan_layers=not args.no_scan_layers,
+        remat=args.remat)
     # init on the dense unparallel clone: the attention holds no params,
     # and running the flash kernel eagerly here would burn a Mosaic
     # compile (tunnel-minutes) on a shape-only computation
@@ -218,6 +224,7 @@ def main():
                    "n_params": n_params, "sp_layout": layout,
                    "use_pallas": use_pallas,
                    "scan_layers": not args.no_scan_layers,
+                   "remat": args.remat,
                    "steps_per_call": steps_per_call, "iters": iters},
         "flops_per_token": flops_per_token,
         "xla_call_flops": xla_call_flops,

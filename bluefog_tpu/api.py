@@ -168,7 +168,7 @@ def neighbor_allreduce(
     dynamic topology is installed (``bf.set_dynamic_topology``), pass the
     iteration counter as ``step`` and the matching schedule of the period is
     used automatically.  ``wire`` compresses the gossiped bytes
-    (``"bf16"``/``"int8"``, see :func:`bluefog_tpu.ops.neighbor_allreduce`).
+    (``"bf16"``/``"int8"``/``"fp8"``, see :func:`bluefog_tpu.ops.neighbor_allreduce`).
     """
     ctx = _mesh.get_context()
     _check_distributed(x, ctx.size)

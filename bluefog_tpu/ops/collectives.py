@@ -41,14 +41,17 @@ def _table(row: np.ndarray, idx: jax.Array, dtype=None) -> jax.Array:
     return t.astype(dtype) if dtype is not None else t
 
 
-WIRE_CODECS = ("bf16", "int8")
+WIRE_CODECS = ("bf16", "int8", "fp8")
 
 
 def _wire_encode(wire: str, x: jax.Array) -> Tuple[jax.Array, ...]:
     """Compress ``x`` for the permute wire.  ``bf16`` halves the bytes by a
     plain cast (the TPU counterpart of the reference's fp16 wire support,
     ``common/half.{h,cc}``); ``int8`` quarters them with symmetric per-buffer
-    quantization whose f32 scale rides beside the payload (4 extra bytes)."""
+    quantization whose f32 scale rides beside the payload (4 extra bytes);
+    ``fp8`` also quarters them but keeps a floating representation
+    (e4m3fn, amax-scaled) — same wire bytes as int8 with better relative
+    precision for the heavy-tailed values gossip payloads actually carry."""
     if wire == "bf16":
         return (x.astype(jnp.bfloat16),)
     if wire == "int8":
@@ -57,6 +60,19 @@ def _wire_encode(wire: str, x: jax.Array) -> Tuple[jax.Array, ...]:
         scale = jnp.where(amax > 0, amax / 127.0, 1.0).astype(jnp.float32)
         q = jnp.clip(jnp.round(xf / scale), -127, 127).astype(jnp.int8)
         return (q, scale)
+    if wire == "fp8":
+        f8max = float(jnp.finfo(jnp.float8_e4m3fn).max)        # 448
+        xf = x.astype(jnp.float32)
+        amax = jnp.max(jnp.abs(xf))
+        # floor at the smallest NORMAL f32: for subnormal amax (< ~6e-39)
+        # amax/448 underflows to 0, xf/scale becomes inf, and e4m3fn has
+        # no inf — the cast would emit NaN and poison the whole combine
+        # (int8 survives the same corner only via its clip).  With the
+        # floor, tiny payloads quantize to 0 instead: graceful, like int8.
+        tiny = float(np.finfo(np.float32).tiny)
+        scale = jnp.where(amax > 0, jnp.maximum(amax / f8max, tiny),
+                          1.0).astype(jnp.float32)
+        return ((xf / scale).astype(jnp.float8_e4m3fn), scale)
     raise ValueError(f"unknown wire codec {wire!r}; choose from {WIRE_CODECS}")
 
 
@@ -104,7 +120,7 @@ def neighbor_allreduce(
     ``ppermute`` zero-fills devices that receive nothing in a round and their
     table weight is 0, so irregular topologies need no masking.
 
-    ``wire`` compresses the permuted bytes (``"bf16"`` 2x, ``"int8"`` 4x with
+    ``wire`` compresses the permuted bytes (``"bf16"`` 2x; ``"int8"`` and ``"fp8"`` 4x with
     a per-buffer scale) — a lever for comm-bound regimes (small batch, DCN
     cross-machine edges).  The self term always combines at full precision;
     gossip averaging tolerates the bounded quantization error the way

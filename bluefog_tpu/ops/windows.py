@@ -80,7 +80,7 @@ def win_put(win: Window, x: jax.Array, sched: CommSchedule, *,
             axis: Axis = "rank", wire: Optional[str] = None) -> Window:
     """Overwrite out-neighbors' mailboxes with ``x`` (reference: WinPut,
     ``mpi_controller.cc:952-1032``).  dst-weighting scales per edge.
-    ``wire`` compresses the permuted bytes (``"bf16"``/``"int8"``, as in
+    ``wire`` compresses the permuted bytes (``"bf16"``/``"int8"``/``"fp8"``, as in
     :func:`bluefog_tpu.ops.neighbor_allreduce`) — async gossip is the
     comm-bound regime the codecs exist for."""
     return _deliver(win, x, sched, axis, accumulate=False, wire=wire)

@@ -73,9 +73,10 @@ def neighbor_communicator(
     ``optimizers.py`` + ``examples/pytorch_benchmark.py:182-208``).
     ``fuse`` gossips one flat buffer per dtype instead of one permute chain
     per leaf (reference fusion buffers, SURVEY.md §2.4).  ``wire`` compresses
-    the gossiped bytes on the wire (``"bf16"``/``"int8"``, see
-    :func:`bluefog_tpu.ops.neighbor_allreduce`); with ``fuse`` the int8 scale
-    is per flat buffer, amortizing the side channel across the whole model.
+    the gossiped bytes on the wire (``"bf16"``/``"int8"``/``"fp8"``, see
+    :func:`bluefog_tpu.ops.neighbor_allreduce`); with ``fuse`` the int8/fp8
+    riding scale is per flat buffer, amortizing the side channel across the
+    whole model.
     """
     if (schedule is None) == (schedules is None):
         raise ValueError("pass exactly one of schedule / schedules")
@@ -543,16 +544,18 @@ def choco_gossip(
 
     def _scheds():
         s = sched if sched is not None else _mesh.static_schedule()
-        if s.uses_dst_weighting and wire != "int8":
+        if s.uses_dst_weighting and wire not in ("int8", "fp8"):
             # the s-tracking invariant s_i == sum_j w_ij xhat_j needs
-            # deq(Q(.)) to commute with the sender-side dst scaling; int8's
-            # symmetric per-buffer quantization is scale-invariant (the
-            # scale rides the wire) but a bf16 cast is not — the public
-            # copies would silently drift from what crossed the wire.
+            # deq(Q(.)) to commute with the sender-side dst scaling; the
+            # amax-scaled per-buffer quantizers (int8, fp8) are
+            # scale-invariant — scaling the input scales only the riding
+            # wire scale, the codes are identical — but a bf16 cast is
+            # not: the public copies would silently drift from what
+            # crossed the wire.
             raise ValueError(
                 "choco_gossip with a dst-weighted schedule "
-                "(uses_dst_weighting=True) requires wire='int8'; "
-                f"wire={wire!r} does not commute with send scaling")
+                "(uses_dst_weighting=True) requires wire='int8' or "
+                f"'fp8'; wire={wire!r} does not commute with send scaling")
         # zero-self variant: the permute rounds carry neighbors' diffs only;
         # the self term is applied locally (full knowledge of own q)
         s0 = _dc.replace(s, self_weight=np.zeros_like(s.self_weight), key="")

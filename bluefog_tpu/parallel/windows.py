@@ -254,7 +254,7 @@ def win_put(tensor: jax.Array, name: str, *,
     """Deliver ``tensor`` into out-neighbors' mailboxes (reference:
     ``bf.win_put``).  ``require_mutex`` is accepted for parity; see module
     docstring.  ``wire`` compresses the permuted bytes
-    (``"bf16"``/``"int8"``) — the async-gossip counterpart of
+    (``"bf16"``/``"int8"``/``"fp8"``) — the async-gossip counterpart of
     ``neighbor_allreduce``'s wire codecs."""
     _move("put", tensor, name, dst_weights, wire=wire)
 

@@ -431,6 +431,32 @@ class TestWireCompression:
             np.testing.assert_allclose(np.asarray(out[r]),
                                        np.full(DIM, expected), atol=0.06)
 
+    def test_fp8_wire_error_bounded_relative(self):
+        bf.set_topology(tu.ExponentialTwoGraph(N), is_weighted=True)
+        rng = np.random.default_rng(1)
+        x = jnp.asarray(rng.normal(size=(N, DIM)).astype(np.float32))
+        exact = np.asarray(bf.neighbor_allreduce(x))
+        wired = np.asarray(bf.neighbor_allreduce(x, wire="fp8"))
+        # e4m3 keeps ~3 mantissa bits: each term errs by <= 2^-4 relative
+        # to its magnitude (plus the amax scaling); the weighted combine
+        # (weights sum to 1) preserves that bound
+        bound = np.abs(np.asarray(x)).max() * 2 ** -3
+        assert np.abs(wired - exact).max() <= bound
+        assert np.abs(wired - exact).max() > 0    # it did quantize
+
+    def test_fp8_wire_close_on_small_integers(self):
+        # ranks 0..7 are exactly representable in e4m3: only the scale
+        # division/multiplication round-trips, so the result is near-exact
+        bf.set_topology(tu.RingGraph(N), is_weighted=False)
+        out = bf.neighbor_allreduce(rank_tensor(), wire="fp8")
+        vals = np.arange(N, dtype=np.float64)
+        topo = tu.RingGraph(N)
+        for r in range(N):
+            nbrs = tu.GetInNeighbors(topo, r)
+            expected = (vals[r] + sum(vals[s] for s in nbrs)) / (len(nbrs) + 1)
+            np.testing.assert_allclose(np.asarray(out[r]),
+                                       np.full(DIM, expected), atol=0.06)
+
     def test_wire_rejects_integer_input(self):
         bf.set_topology(tu.RingGraph(N), is_weighted=True)
         x = jnp.zeros((N, DIM), jnp.int32)

@@ -217,8 +217,11 @@ def test_choco_rejects_dst_weighted_bf16_wire():
     strat = bfopt.choco_gossip(optax.sgd(0.03), dst, wire="bf16")
     with pytest.raises(ValueError, match="int8"):
         strat.init({"x": jnp.zeros((N, 1, 4))})
-    # int8's per-buffer scale rides the wire, so the same schedule is fine
+    # the amax-scaled quantizers' per-buffer scale rides the wire, so the
+    # same schedule is fine with either of them
     bfopt.choco_gossip(optax.sgd(0.03), dst, wire="int8").init(
+        {"x": jnp.zeros((N, 1, 4))})
+    bfopt.choco_gossip(optax.sgd(0.03), dst, wire="fp8").init(
         {"x": jnp.zeros((N, 1, 4))})
 
 

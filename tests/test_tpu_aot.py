@@ -285,6 +285,30 @@ def test_int8_wire_shrinks_permute_payload(tpu_mesh):
     assert not any(re.search(r"f32\[\d{4,}", lines[l]) for l in starts)
 
 
+def test_fp8_wire_shrinks_permute_payload(tpu_mesh):
+    """wire="fp8" carries f8e4m3 buffers on the compiled v5e wire — the
+    int8 byte footprint with floating relative precision; the barriers
+    keep XLA from fusing the casts back into a full-width permute."""
+    sched = sch.compile_topology(tu.ExponentialTwoGraph(N))
+
+    def per_rank(x):
+        from bluefog_tpu.ops import collectives as C
+        return C.neighbor_allreduce(x[0], sched, wire="fp8")[None]
+
+    fn = jax.jit(jax.shard_map(
+        per_rank, mesh=tpu_mesh, in_specs=(P("rank"),),
+        out_specs=P("rank")))
+    x = jax.ShapeDtypeStruct(
+        (N, 1024, 1024), jnp.float32,
+        sharding=NamedSharding(tpu_mesh, P("rank")))
+    txt = fn.lower(x).compile().as_text()
+    starts = _op_lines(txt, "collective-permute-start")
+    lines = txt.splitlines()
+    payload = [l for l in starts if re.search(r"f8e4m3", lines[l])]
+    assert len(payload) == 3, [lines[l][:120] for l in starts]
+    assert not any(re.search(r"f32\[\d{4,}", lines[l]) for l in starts)
+
+
 def test_bf16_wire_halves_permute_payload(tpu_mesh):
     """wire="bf16" on f32 data really halves the TPU wire: the gossip
     permutes carry bf16 buffers.  Guarded by optimization barriers in

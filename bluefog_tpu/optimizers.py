@@ -540,11 +540,12 @@ def choco_gossip(
     """
     import dataclasses as _dc
 
-    from .ops.collectives import _wire_decode, _wire_encode
+    from .ops.collectives import _parse_wire, _wire_decode, _wire_encode
 
     def _scheds():
         s = sched if sched is not None else _mesh.static_schedule()
-        if s.uses_dst_weighting and wire not in ("int8", "fp8"):
+        if s.uses_dst_weighting and _parse_wire(wire)[0] not in ("int8",
+                                                                 "fp8"):
             # the s-tracking invariant s_i == sum_j w_ij xhat_j needs
             # deq(Q(.)) to commute with the sender-side dst scaling; the
             # amax-scaled per-buffer quantizers (int8, fp8) are
@@ -581,7 +582,8 @@ def choco_gossip(
         new_bufs, new_xhat, new_s = [], [], []
         for buf, xh, sb in zip(fp.buffers, xhat, s):
             diff = buf - xh
-            qd = _wire_decode(wire, _wire_encode(wire, diff), buf.dtype)
+            qd = _wire_decode(wire, _wire_encode(wire, diff), buf.dtype,
+                              shape=diff.shape)
             with named_span("COMMUNICATE"):
                 recv = ops.neighbor_allreduce(diff, s_zero, axis=axis,
                                               wire=wire)

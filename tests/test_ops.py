@@ -457,6 +457,47 @@ class TestWireCompression:
             np.testing.assert_allclose(np.asarray(out[r]),
                                        np.full(DIM, expected), atol=0.06)
 
+    def test_block_codec_isolates_outliers(self):
+        # one huge outlier costs per-BUFFER int8 all its resolution for
+        # the rest of the payload; per-BLOCK scales confine the damage to
+        # the outlier's own 256-element block
+        from bluefog_tpu.ops.collectives import _wire_decode, _wire_encode
+        rng = np.random.default_rng(5)
+        x = jnp.asarray(rng.normal(size=(2048,)).astype(np.float32))
+        x = x.at[7].set(1e4)
+        rt = lambda w: np.asarray(
+            _wire_decode(w, _wire_encode(w, x), jnp.float32, shape=x.shape))
+        mask = np.ones(2048, bool)
+        mask[:256] = False                      # outside the outlier block
+        err_buf = np.abs(rt("int8") - np.asarray(x))[mask].max()
+        err_blk = np.abs(rt("int8@256") - np.asarray(x))[mask].max()
+        assert err_blk < err_buf / 10, (err_blk, err_buf)
+        # fp8 also supports blocks; padding round-trips odd sizes
+        y = x[:1000]                            # 1000 % 256 != 0
+        out = _wire_decode("fp8@256", _wire_encode("fp8@256", y),
+                           jnp.float32, shape=y.shape)
+        assert out.shape == y.shape
+        np.testing.assert_allclose(np.asarray(out)[mask[:1000]],
+                                   np.asarray(y)[mask[:1000]],
+                                   rtol=0.1, atol=0.1)
+
+    def test_block_codec_through_gossip(self):
+        bf.set_topology(tu.ExponentialTwoGraph(N), is_weighted=True)
+        rng = np.random.default_rng(2)
+        x = jnp.asarray(rng.normal(size=(N, DIM)).astype(np.float32))
+        exact = np.asarray(bf.neighbor_allreduce(x))
+        for w in ("int8@64", "fp8@64"):
+            wired = np.asarray(bf.neighbor_allreduce(x, wire=w))
+            bound = np.abs(np.asarray(x)).max() * 2 ** -3
+            assert np.abs(wired - exact).max() <= bound, w
+
+    def test_bad_wire_block_suffix_rejected(self):
+        bf.set_topology(tu.RingGraph(N), is_weighted=True)
+        with pytest.raises(ValueError, match="block size"):
+            bf.neighbor_allreduce(rank_tensor(), wire="int8@zero")
+        with pytest.raises(ValueError, match="plain cast"):
+            bf.neighbor_allreduce(rank_tensor(), wire="bf16@256")
+
     def test_wire_rejects_integer_input(self):
         bf.set_topology(tu.RingGraph(N), is_weighted=True)
         x = jnp.zeros((N, DIM), jnp.int32)

@@ -32,9 +32,11 @@ def main():
                                  "win_put", "pull_get", "push_sum",
                                  "powersgd", "empty"])
     parser.add_argument("--atc", action="store_true")
-    parser.add_argument("--wire", default=None, choices=["bf16", "int8"],
-                        help="compress gossip bytes on the wire "
-                             "(neighbor/hierarchical strategies)")
+    parser.add_argument("--wire", default=None,
+                        help="compress gossip bytes on the wire (neighbor/"
+                             "hierarchical strategies): bf16 | int8 | fp8; "
+                             "quantizers accept an @B block suffix "
+                             "(e.g. int8@256)")
     parser.add_argument("--dynamic-topology", action="store_true")
     parser.add_argument("--batch-size", type=int, default=32)
     parser.add_argument("--num-warmup", type=int, default=1)

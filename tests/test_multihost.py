@@ -101,6 +101,20 @@ CHILD = textwrap.dedent("""
         for sa, sb in zip(a.addressable_shards, b.addressable_shards):
             assert np.array_equal(np.asarray(sa.data), np.asarray(sb.data)), key
     assert ckpt.latest_step(ckdir) == 7
+
+    # wire codecs across the REAL process boundary: the f8e4m3 / blocked
+    # payloads must survive the cross-process collective transport, not
+    # just the in-process virtual mesh
+    xw = jnp.broadcast_to(jnp.arange(float(n))[:, None], (n, 8))
+    for w in ("fp8", "int8@4", "fp8@4"):
+        outw = bf.synchronize(
+            bf.neighbor_allreduce(bf.shard_distributed(xw), wire=w))
+        for shard in outw.addressable_shards:
+            r = shard.index[0].start
+            nbrs = [(r - 1) %% n, (r + 1) %% n]
+            exp = (r + sum(nbrs)) / 3.0
+            got = float(np.asarray(shard.data)[0, 0])
+            assert abs(got - exp) < 0.1, (w, r, got, exp)
     print(f"proc {jax.process_index()}: MULTIHOST-OK", flush=True)
 """ % REPO)
 

@@ -309,6 +309,36 @@ def test_fp8_wire_shrinks_permute_payload(tpu_mesh):
     assert not any(re.search(r"f32\[\d{4,}", lines[l]) for l in starts)
 
 
+def test_blocked_wire_payloads_stay_compressed(tpu_mesh):
+    """The @B blocked quantizers keep the compiled v5e wire compressed:
+    payload permutes are s8 / f8e4m3 in the padded [nb, B] layout with an
+    f32 per-block scales vector alongside — the pad/reshape around the
+    optimization barriers must not give XLA an excuse to ship full-width
+    bytes."""
+    sched = sch.compile_topology(tu.ExponentialTwoGraph(N))
+
+    for wire, pat in (("int8@256", r"s8\["), ("fp8@256", r"f8e4m3")):
+        def per_rank(x, wire=wire):
+            from bluefog_tpu.ops import collectives as C
+            return C.neighbor_allreduce(x[0], sched, wire=wire)[None]
+
+        fn = jax.jit(jax.shard_map(
+            per_rank, mesh=tpu_mesh, in_specs=(P("rank"),),
+            out_specs=P("rank")))
+        x = jax.ShapeDtypeStruct(
+            (N, 1000, 1001), jnp.float32,       # NOT a multiple of 256
+            sharding=NamedSharding(tpu_mesh, P("rank")))
+        txt = fn.lower(x).compile().as_text()
+        starts = _op_lines(txt, "collective-permute-start")
+        lines = txt.splitlines()
+        payload = [l for l in starts if re.search(pat, lines[l])]
+        assert len(payload) == 3, (wire, [lines[l][:120] for l in starts])
+        # the scales vector may permute in f32 (3912 blocks = 4 bytes
+        # each); full-width payloads (>= 6 digits of f32) must not
+        assert not any(re.search(r"f32\[\d{6,}", lines[l])
+                       for l in starts), wire
+
+
 def test_bf16_wire_halves_permute_payload(tpu_mesh):
     """wire="bf16" on f32 data really halves the TPU wire: the gossip
     permutes carry bf16 buffers.  Guarded by optimization barriers in

@@ -176,3 +176,38 @@ def test_rescue_artifact_is_marked_and_exits_nonzero():
     doc = json.loads([ln for ln in p.stdout.splitlines() if ln.strip()][-1])
     assert doc["ok"] is True and doc["value"] > 0
     assert p.returncode == 0
+
+
+def test_best_banked_config_selection(tmp_path, monkeypatch):
+    """The driver's graded run adopts the FASTEST banked on-TPU config —
+    CPU fallbacks, rescue lines and partial records can never steer it."""
+    spec = importlib.util.spec_from_file_location("bench_cfg", _BENCH)
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    monkeypatch.setenv("BLUEFOG_MEASURED_DIR", str(tmp_path))
+
+    def write(name, **kw):
+        with open(tmp_path / name, "w") as f:
+            json.dump(kw, f)
+
+    assert bench._best_banked_config() is None       # empty dir
+
+    write("bench_r05.json", ok=True, on_accelerator=True, value=1961.0,
+          batch_per_chip=64, steps_per_call=5)
+    write("bench_b256_r05x.json", ok=True, on_accelerator=True,
+          value=2400.0, batch_per_chip=256, steps_per_call=10)
+    write("bench_r04.json", ok=True, on_accelerator=False, value=9999.0,
+          batch_per_chip=8, steps_per_call=1)        # CPU: ignored
+    write("bench_bad.json", ok=False, on_accelerator=True, value=8888.0,
+          batch_per_chip=4, steps_per_call=1)        # rescue: ignored
+    write("bench_partial.json", ok=True, on_accelerator=True, value=7777.0)
+    write("bench_smoke.json", ok=True, on_accelerator=True, value=9e9,
+          batch_per_chip=1, steps_per_call=1, image_size=32,
+          num_classes=10)                          # shrunken workload: ignored
+    write("bench_typec.json", ok=True, on_accelerator=True, value="fast",
+          batch_per_chip=64, steps_per_call=5)     # corrupt field: ignored
+    (tmp_path / "bench_garbage.json").write_text("{not json")
+
+    batch, spc, src = bench._best_banked_config()
+    assert (batch, spc) == (256, 10)
+    assert src == "bench_b256_r05x.json"

@@ -48,8 +48,13 @@ def render(tag):
     if bench and bench.get("ok"):
         dev = bench.get("device", "?")
         acc = "TPU" if bench.get("on_accelerator") else "CPU FALLBACK"
+        # batch/steps_per_call alongside the value: the config may adopt
+        # a banked-best shape across rounds (bench._best_banked_config),
+        # so the headline must say what shape produced the number
+        cfg = (f"b{bench.get('batch_per_chip')}"
+               f"·k{bench.get('steps_per_call')}")
         rows.append(
-            f"| ResNet-50 synthetic ({acc} {dev}) | "
+            f"| ResNet-50 synthetic ({acc} {dev}, {cfg}) | "
             f"**{bench.get('value')} {bench.get('unit', '')}** | "
             f"MFU {_fmt_mfu(bench.get('mfu'))} | "
             f"vs V100 baseline x{bench.get('vs_baseline')} |")

@@ -4,11 +4,16 @@
 
 PY ?= python
 
-.PHONY: test test_basic test_ops test_win_ops test_optimizer test_hier \
-	test_native test_examples verify native clean hw-watch
+.PHONY: test test-fast test_basic test_ops test_win_ops test_optimizer \
+	test_hier test_native test_examples verify native clean hw-watch
 
 test:
 	$(PY) -m pytest tests/ -q
+
+# the CI tier: skips tests marked `slow` (multi-process bootstraps and
+# compile-heavy end-to-end sweeps) so the whole run fits a short budget
+test-fast:
+	$(PY) -m pytest tests/ -q -m "not slow"
 
 # everything verifiable without hardware: suite + example smokes + the
 # multi-chip dryrun the driver runs

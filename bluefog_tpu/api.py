@@ -37,9 +37,6 @@ __all__ = [
     "barrier", "synchronize", "poll", "resolve_schedule", "shard_distributed",
 ]
 
-_jit_cache: Dict = {}
-
-
 def _dispatch(op_name, fn, *args):
     """Dispatch one eager op under a host timeline span (no-op when the
     timeline is off) — the per-op activities the reference's negotiation
@@ -49,10 +46,10 @@ def _dispatch(op_name, fn, *args):
 
 
 def _cached(key, build):
-    fn = _jit_cache.get(key)
-    if fn is None:
-        fn = _jit_cache[key] = build()
-    return fn
+    # The executable cache lives on the parallel context (one process-level
+    # cache shared with the window ops), so repeated CommSchedule->jaxpr
+    # lowering never retraces regardless of which layer dispatches it.
+    return _mesh.cached_program(key, build)
 
 
 def _per_rank(inner):
@@ -62,15 +59,17 @@ def _per_rank(inner):
     return f
 
 
-def _shard_map_1d(inner, mesh: Mesh):
+def _shard_map_1d(inner, mesh: Mesh, donate: bool = False):
     return jax.jit(jax.shard_map(
-        inner, mesh=mesh, in_specs=P("rank"), out_specs=P("rank")))
+        inner, mesh=mesh, in_specs=P("rank"), out_specs=P("rank")),
+        donate_argnums=(0,) if donate else ())
 
 
-def _shard_map_2d(inner, mesh: Mesh):
+def _shard_map_2d(inner, mesh: Mesh, donate: bool = False):
     return jax.jit(jax.shard_map(
         inner, mesh=mesh,
-        in_specs=P(("machine", "local")), out_specs=P(("machine", "local"))))
+        in_specs=P(("machine", "local")), out_specs=P(("machine", "local"))),
+        donate_argnums=(0,) if donate else ())
 
 
 def _check_distributed(x, n: int):
@@ -161,6 +160,7 @@ def neighbor_allreduce(
     schedule: Optional[CommSchedule] = None,
     step: Optional[int] = None,
     wire: Optional[str] = None,
+    donate: bool = False,
 ) -> jax.Array:
     """Weighted neighbor averaging of each rank's slice (the flagship op).
 
@@ -169,6 +169,12 @@ def neighbor_allreduce(
     iteration counter as ``step`` and the matching schedule of the period is
     used automatically.  ``wire`` compresses the gossiped bytes
     (``"bf16"``/``"int8"``/``"fp8"``, see :func:`bluefog_tpu.ops.neighbor_allreduce`).
+
+    ``donate=True`` donates ``x``'s buffer to the computation (output and
+    input have identical shape/sharding, so XLA averages in place instead
+    of allocating a fresh result).  Opt-in because it invalidates the
+    caller's ``x`` — the right mode on step paths that rebind, e.g.
+    ``x = bf.neighbor_allreduce(x, donate=True)``.
     """
     ctx = _mesh.get_context()
     _check_distributed(x, ctx.size)
@@ -182,11 +188,11 @@ def neighbor_allreduce(
         schedule = dyn[int(step) % len(dyn)]
     sched = resolve_schedule(self_weight, src_weights, dst_weights, schedule)
     fn = _cached(
-        ("nar", sched, ctx.mesh, x.shape, x.dtype.name, wire),
+        ("nar", sched, ctx.mesh, x.shape, x.dtype.name, wire, donate),
         lambda: _shard_map_1d(
             _per_rank(partial(ops.neighbor_allreduce, sched=sched,
                               axis="rank", wire=wire)),
-            ctx.mesh))
+            ctx.mesh, donate=donate))
     return _dispatch("neighbor_allreduce", fn, x)
 
 
@@ -258,15 +264,18 @@ def ragged_neighbor_allgather(
     return _dispatch("ragged_neighbor_allgather", fn, x, lengths)
 
 
-def allreduce(x: jax.Array, average: bool = True) -> jax.Array:
-    """Global (weighted-uniform) allreduce. Reference: ``bf.allreduce``."""
+def allreduce(x: jax.Array, average: bool = True,
+              *, donate: bool = False) -> jax.Array:
+    """Global (weighted-uniform) allreduce. Reference: ``bf.allreduce``.
+
+    ``donate=True``: reduce in place (see :func:`neighbor_allreduce`)."""
     ctx = _mesh.get_context()
     _check_distributed(x, ctx.size)
     fn = _cached(
-        ("ar", average, ctx.mesh, x.shape, x.dtype.name),
+        ("ar", average, ctx.mesh, x.shape, x.dtype.name, donate),
         lambda: _shard_map_1d(
             _per_rank(partial(ops.allreduce, average=average, axis="rank")),
-            ctx.mesh))
+            ctx.mesh, donate=donate))
     return _dispatch("allreduce", fn, x)
 
 
@@ -301,15 +310,18 @@ def ragged_allgather(x: jax.Array, lengths) -> Tuple[jax.Array, jax.Array]:
     return allgather(x), allgather(lengths)
 
 
-def broadcast(x: jax.Array, root_rank: int) -> jax.Array:
-    """Every rank's slice becomes root's slice. Reference: ``bf.broadcast``."""
+def broadcast(x: jax.Array, root_rank: int,
+              *, donate: bool = False) -> jax.Array:
+    """Every rank's slice becomes root's slice. Reference: ``bf.broadcast``.
+
+    ``donate=True``: overwrite in place (see :func:`neighbor_allreduce`)."""
     ctx = _mesh.get_context()
     _check_distributed(x, ctx.size)
     fn = _cached(
-        ("bc", root_rank, ctx.mesh, x.shape, x.dtype.name),
+        ("bc", root_rank, ctx.mesh, x.shape, x.dtype.name, donate),
         lambda: _shard_map_1d(
             _per_rank(partial(ops.broadcast, root_rank=root_rank, axis="rank")),
-            ctx.mesh))
+            ctx.mesh, donate=donate))
     return _dispatch("broadcast", fn, x)
 
 
@@ -319,19 +331,22 @@ def pair_gossip(
     *,
     self_weight: float = 0.5,
     pair_weight: float = 0.5,
+    donate: bool = False,
 ) -> jax.Array:
-    """Paired exchange-and-average. Reference: ``bf.pair_gossip``."""
+    """Paired exchange-and-average. Reference: ``bf.pair_gossip``.
+
+    ``donate=True``: average in place (see :func:`neighbor_allreduce`)."""
     ctx = _mesh.get_context()
     _check_distributed(x, ctx.size)
     key = ("pg", tuple(int(p) for p in partners), float(self_weight),
-           float(pair_weight), ctx.mesh, x.shape, x.dtype.name)
+           float(pair_weight), ctx.mesh, x.shape, x.dtype.name, donate)
     fn = _cached(
         key,
         lambda: _shard_map_1d(
             _per_rank(partial(
                 ops.pair_gossip, partners=tuple(int(p) for p in partners),
                 self_weight=self_weight, pair_weight=pair_weight, axis="rank")),
-            ctx.mesh))
+            ctx.mesh, donate=donate))
     return _dispatch("pair_gossip", fn, x)
 
 
@@ -342,12 +357,14 @@ def hierarchical_neighbor_allreduce(
     src_machine_weights=None,
     dst_machine_weights=None,
     schedule: Optional[CommSchedule] = None,
+    donate: bool = False,
 ) -> jax.Array:
     """Machine-level neighbor averaging (reference: ``mpi_ops.py:848-864``).
 
     Intra-machine average over the ``local`` mesh axis, then machine-level
     gossip over the ``machine`` axis; the result is replicated within each
-    machine.
+    machine.  ``donate=True``: average in place (see
+    :func:`neighbor_allreduce`).
     """
     ctx = _mesh.get_context()
     _check_distributed(x, ctx.size)
@@ -356,12 +373,12 @@ def hierarchical_neighbor_allreduce(
         self_weight, src_machine_weights, dst_machine_weights, schedule,
         size=ctx.machine_size, default_schedule=_mesh.machine_schedule)
     fn = _cached(
-        ("hnar", sched, ctx.mesh_2d, x.shape, x.dtype.name),
+        ("hnar", sched, ctx.mesh_2d, x.shape, x.dtype.name, donate),
         lambda: _shard_map_2d(
             _per_rank(partial(
                 ops.hierarchical_neighbor_allreduce, machine_sched=sched,
                 machine_axis="machine", local_axis="local")),
-            ctx.mesh_2d))
+            ctx.mesh_2d, donate=donate))
     return _dispatch("hierarchical_neighbor_allreduce", fn, x)
 
 
@@ -378,7 +395,15 @@ def synchronize(x):
 
 
 def poll(x) -> bool:
-    """True if ``x``'s computation has completed (reference: ``bf.poll``)."""
+    """True if ``x``'s computation has completed (reference: ``bf.poll``).
+
+    .. warning:: ``is_ready`` trusts the runtime's ready event, and some
+       PJRT plugins (the axon TPU tunnel among them) fire that event at
+       *dispatch* time, not completion — the same caveat :func:`hard_sync`
+       documents.  On those backends ``poll`` answers "has the program been
+       enqueued", not "has it finished"; gate anything timing- or
+       completion-sensitive on :func:`hard_sync` instead.
+    """
     leaves = jax.tree_util.tree_leaves(x)
     return all(leaf.is_ready() for leaf in leaves if hasattr(leaf, "is_ready"))
 

@@ -1,0 +1,148 @@
+"""Run the jax>=0.9-style surface this codebase targets on older jax.
+
+The package is written against the modern public API (``jax.shard_map``
+with ``check_vma``, ``jax.typeof``, ``lax.pcast``,
+``jax.distributed.is_initialized``).  Older installs (0.4.x) spell these
+``jax.experimental.shard_map.shard_map(check_rep=...)``, expose no
+``typeof``/``pcast``, and keep distributed-client state private.  Rather
+than sprinkle version checks through every op, :func:`install` patches the
+handful of missing names onto ``jax``/``jax.lax`` once, at package import.
+
+Semantics notes for the old-jax spellings:
+
+* ``check_vma`` maps to ``check_rep`` — same switch, earlier name.
+* ``lax.pcast(x, axis, to='varying')`` is the identity: every call site
+  uses it only to mark fresh accumulators as device-varying so scan-carry
+  types match under VMA tracking, a concept the 0.4.x rep-checker handles
+  automatically via pbroadcast insertion.
+* ``jax.typeof`` returns the abstract value; it has no ``.vma`` attribute
+  on old jax, which every caller already guards with ``getattr``/except.
+
+Each patch is applied only when the name is missing, so on a modern jax
+this module is a no-op and the native implementations are used.
+"""
+import functools
+
+import jax
+from jax import lax
+
+__all__ = ["install"]
+
+
+def _install_shard_map():
+    if hasattr(jax, "shard_map"):
+        return
+    from jax.experimental.shard_map import shard_map as _legacy
+
+    @functools.wraps(_legacy)
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True, **kw):
+        kw.pop("axis_names", None)   # new-API only: subset-of-mesh manual axes
+        check_rep = kw.pop("check_rep", check_vma)
+        return _legacy(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                       check_rep=check_rep, **kw)
+
+    jax.shard_map = shard_map
+
+
+def _install_typeof():
+    if not hasattr(jax, "typeof"):
+        jax.typeof = lambda x: jax.core.get_aval(x)
+
+
+def _install_pcast():
+    if not hasattr(lax, "pcast"):
+        lax.pcast = lambda x, axis_name, *, to="varying": x
+    if not hasattr(lax, "pvary"):
+        lax.pvary = lambda x, axis_name: x
+
+
+def _install_axis_size():
+    if hasattr(lax, "axis_size"):
+        return
+
+    def axis_size(axis_name):
+        try:
+            frame = jax.core.axis_frame(axis_name)
+            if frame.size is not None:
+                return frame.size
+        except Exception:
+            pass
+        return lax.psum(1, axis_name)
+
+    lax.axis_size = axis_size
+
+
+def _install_shape_dtype_struct_vma():
+    try:
+        jax.ShapeDtypeStruct((1,), "float32", vma=frozenset())
+        return
+    except TypeError:
+        pass
+
+    base = jax.ShapeDtypeStruct
+
+    class ShapeDtypeStruct(base):
+        def __init__(self, shape, dtype, *args, vma=None, **kw):
+            super().__init__(shape, dtype, *args, **kw)
+
+    jax.ShapeDtypeStruct = ShapeDtypeStruct
+
+
+def _install_lowered_as_text_kwargs():
+    """New jax grew ``Lowered.as_text(..., debug_info=True)``; old
+    signatures reject the kwarg.  Route a debug_info request through the
+    MLIR printer's ``enable_debug_info`` (named_scope labels live in the
+    location metadata) rather than version-check every HLO-inspecting
+    test/tool."""
+    from jax._src import stages
+
+    orig = stages.Lowered.as_text
+    try:
+        orig(None, debug_info=True)              # probe the signature
+        return
+    except TypeError:
+        pass
+    except Exception:
+        return                                   # signature already accepts it
+
+    @functools.wraps(orig)
+    def as_text(self, dialect=None, **kw):
+        debug = kw.pop("debug_info", False)
+        if debug:
+            try:
+                ir = self.compiler_ir(dialect) if dialect \
+                    else self.compiler_ir()
+                return ir.operation.get_asm(enable_debug_info=True)
+            except Exception:
+                pass                             # fall back to plain text
+        return orig(self, dialect) if dialect else orig(self)
+
+    stages.Lowered.as_text = as_text
+
+
+def _install_distributed_is_initialized():
+    if hasattr(jax.distributed, "is_initialized"):
+        return
+
+    def is_initialized():
+        try:
+            from jax._src import distributed as _impl
+            return _impl.global_state.client is not None
+        except Exception:
+            return False
+
+    jax.distributed.is_initialized = is_initialized
+
+
+def install():
+    """Patch missing modern-API names onto an old jax.  Idempotent."""
+    _install_shard_map()
+    _install_typeof()
+    _install_pcast()
+    _install_axis_size()
+    _install_shape_dtype_struct_vma()
+    _install_lowered_as_text_kwargs()
+    _install_distributed_is_initialized()
+
+
+install()

@@ -52,6 +52,10 @@ def _parse_wire(wire: str) -> Tuple[str, Optional[int]]:
     its own block's resolution instead of the whole payload's, for
     4/B extra bytes per block (~1.6 % at B=256).  bf16 is a plain cast
     and takes no block size."""
+    if not isinstance(wire, str):
+        raise ValueError(
+            f"unknown wire codec {wire!r}: pass one of {WIRE_CODECS} "
+            "(optionally with an @B block-size suffix for int8/fp8)")
     base, sep, blk = wire.partition("@")
     if not sep:
         return base, None

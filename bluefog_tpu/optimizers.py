@@ -1206,11 +1206,21 @@ def init_distributed(strategy: DecentralizedOptimizer, dist_params):
     return jax.tree.map(lambda x: jnp.broadcast_to(x[None], (n,) + x.shape), state)
 
 
+# Argument positions make_train_step donates (params, opt-state).  bench and
+# the AOT tests read this instead of hard-coding the tuple, so a future
+# signature change cannot silently desynchronize the reported `donated` flag
+# from what the executable actually aliases.
+TRAIN_STEP_DONATE_ARGNUMS = (0, 1)
+STATEFUL_TRAIN_STEP_DONATE_ARGNUMS = (0, 1, 2)
+
+
 def make_train_step(
     grad_fn: Callable[[Any, Any], Tuple[jax.Array, Any]],
     strategy: DecentralizedOptimizer,
     *,
     steps_per_call: int = 1,
+    reuse_batch: bool = False,
+    donate: bool = True,
 ):
     """Build the jitted SPMD training step over the context mesh.
 
@@ -1226,7 +1236,21 @@ def make_train_step(
     per scan amortizes host overhead and lets XLA overlap the gossip
     collectives of step t with the compute of step t+1 (the role the
     reference's background thread + nonblocking ops play,
-    ``operations.cc:453-520``).
+    ``operations.cc:453-520``).  Dynamic topologies keep rotating inside
+    the fused body: the communicator's ``lax.switch`` dispatches on the
+    step counter carried in ``state``, which advances every scan iteration.
+
+    ``reuse_batch=True`` (requires ``steps_per_call > 1``) feeds the SAME
+    batch to every step of the fused loop instead of slicing a steps axis:
+    batch leaves stay ``[n, ...]``, so a k-step call costs no k-fold batch
+    replication in HBM or on the host->device path.  This is the synthetic
+    -benchmark shape (bench.py) and the right mode whenever the data loader
+    is not the object under test.
+
+    ``donate=False`` disables buffer donation for callers that must keep
+    reading the pre-step params/state after the call; by default both are
+    donated (:data:`TRAIN_STEP_DONATE_ARGNUMS`) so XLA updates them in
+    place instead of round-tripping fresh HBM allocations.
     """
     ctx = _mesh.get_context()
     mesh = ctx.mesh if strategy.axes == ("rank",) else ctx.mesh_2d
@@ -1236,7 +1260,8 @@ def make_train_step(
         loss, grads = grad_fn(p, b)
         return loss, grads, ns
 
-    inner = _stateful_per_rank(grad3, strategy, steps_per_call, lambda ns: ns)
+    inner = _stateful_per_rank(grad3, strategy, steps_per_call, lambda ns: ns,
+                               reuse_batch=reuse_batch)
 
     def per_rank(params, state, batch):
         new_params, _, new_state, losses = inner(params, {}, state, batch)
@@ -1248,13 +1273,20 @@ def make_train_step(
     return jax.jit(
         jax.shard_map(per_rank, mesh=mesh, in_specs=(spec, spec, spec),
                       out_specs=(spec, spec, spec)),
-        donate_argnums=(0, 1))
+        donate_argnums=TRAIN_STEP_DONATE_ARGNUMS if donate else ())
 
 
-def _stateful_per_rank(grad_fn, strategy, steps_per_call, sync):
+def _stateful_per_rank(grad_fn, strategy, steps_per_call, sync,
+                       reuse_batch=False):
     """Shared per-rank step body: slice off the rank axis, scan
     (grad -> state sync -> strategy update), re-stack.  ``grad_fn(p, ns, b)
-    -> (loss, grads, new_ns)``; ``sync`` post-processes the net state."""
+    -> (loss, grads, new_ns)``; ``sync`` post-processes the net state.
+    ``reuse_batch``: scan over nothing (``xs=None``) and close over one
+    steps-axis-free batch instead of slicing ``batch[t]`` each step."""
+    if reuse_batch and steps_per_call == 1:
+        raise ValueError("reuse_batch requires steps_per_call > 1 (a single "
+                         "step has no steps axis to elide)")
+
     def per_rank(params, net_state, dstate, batch):
         params, net_state, dstate, batch = jax.tree.map(
             lambda x: x[0], (params, net_state, dstate, batch))
@@ -1272,11 +1304,12 @@ def _stateful_per_rank(grad_fn, strategy, steps_per_call, sync):
 
         def body(carry, b):
             p, ns, s = carry
-            p, ns, s, loss = one(p, ns, s, b)
+            p, ns, s, loss = one(p, ns, s, batch if reuse_batch else b)
             return (p, ns, s), loss
 
         (params, net_state, dstate), losses = lax.scan(
-            body, (params, net_state, dstate), batch, length=steps_per_call)
+            body, (params, net_state, dstate),
+            None if reuse_batch else batch, length=steps_per_call)
         return jax.tree.map(
             lambda x: x[None], (params, net_state, dstate, losses))
 
@@ -1288,6 +1321,8 @@ def make_stateful_train_step(
     strategy: DecentralizedOptimizer,
     *,
     steps_per_call: int = 1,
+    reuse_batch: bool = False,
+    donate: bool = True,
     state_sync: Optional[str] = None,
     state_sync_schedule: Optional[CommSchedule] = None,
 ):
@@ -1306,6 +1341,10 @@ def make_stateful_train_step(
     overrides the context schedule), ``"allreduce"`` globally averages it.
     Integer leaves (counters) are never averaged.  Syncing requires a
     rank-axis strategy (1-D mesh).
+
+    ``steps_per_call``, ``reuse_batch``, and ``donate`` behave exactly as in
+    :func:`make_train_step` (donation here covers params, net state, and
+    optimizer state — :data:`STATEFUL_TRAIN_STEP_DONATE_ARGNUMS`).
     """
     ctx = _mesh.get_context()
     mesh = ctx.mesh if strategy.axes == ("rank",) else ctx.mesh_2d
@@ -1337,8 +1376,9 @@ def make_stateful_train_step(
         with named_span("STATE_SYNC"):
             return jax.tree.map(leaf, ns)
 
-    inner = _stateful_per_rank(grad_fn, strategy, steps_per_call, sync)
+    inner = _stateful_per_rank(grad_fn, strategy, steps_per_call, sync,
+                               reuse_batch=reuse_batch)
     return jax.jit(
         jax.shard_map(inner, mesh=mesh, in_specs=(spec,) * 4,
                       out_specs=(spec,) * 4),
-        donate_argnums=(0, 1, 2))
+        donate_argnums=STATEFUL_TRAIN_STEP_DONATE_ARGNUMS if donate else ())

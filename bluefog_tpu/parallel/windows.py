@@ -50,14 +50,13 @@ class _WindowEntry:
 _registry: Dict[str, _WindowEntry] = {}
 _assoc_p: Dict[str, wops.Window] = {}    # associated-P scalar channel per window
 _assoc_p_enabled: bool = False
-_jit_cache: Dict = {}
 
 
 def _cached(key, build):
-    fn = _jit_cache.get(key)
-    if fn is None:
-        fn = _jit_cache[key] = build()
-    return fn
+    # shared process-level program cache (context.cached_program): window
+    # dispatch reuses the same executables as the eager op API, and a
+    # CommSchedule in the key never re-lowers
+    return _mesh.cached_program(("win",) + key, build)
 
 
 def _win_specs():

@@ -24,6 +24,12 @@ import pytest  # noqa: E402
 # the test suite.  Override back: tests are hermetic on the host backend.
 jax.config.update("jax_platforms", "cpu")
 
+# Install the old-jax compatibility shims (jax.shard_map / jax.typeof /
+# lax.pcast / distributed.is_initialized) before any test module touches
+# them directly — test files that use jax.shard_map without importing the
+# package first would otherwise depend on collection order.
+import bluefog_tpu.compat  # noqa: E402,F401
+
 
 @pytest.fixture(scope="session")
 def cpu_devices():

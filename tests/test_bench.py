@@ -69,7 +69,7 @@ def test_bench_script_both_steps_axis_contracts(steps_per_call):
     assert out["probe_attempts"] == 3           # probe telemetry passes through
 
 
-def test_run_bench_accelerator_branch_on_virtual_mesh(monkeypatch):
+def test_run_bench_accelerator_branch_on_virtual_mesh(tmp_path, monkeypatch):
     """The on_accelerator=True code path (scan of 5 steps/call, no CPU
     override) — the branch the graded TPU run takes — exercised on the
     conftest mesh, where the platform is already pinned to CPU."""
@@ -77,6 +77,9 @@ def test_run_bench_accelerator_branch_on_virtual_mesh(monkeypatch):
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
 
+    # hermetic measured dir: banked artifacts must not steer the config,
+    # and a banked roofline must not become this run's MFU ceiling
+    monkeypatch.setenv("BLUEFOG_MEASURED_DIR", str(tmp_path))
     monkeypatch.setenv("BLUEFOG_BENCH_BATCH", "1")
     monkeypatch.setenv("BLUEFOG_BENCH_ITERS", "1")
     monkeypatch.setenv("BLUEFOG_BENCH_IMAGE_SIZE", "32")
@@ -87,6 +90,34 @@ def test_run_bench_accelerator_branch_on_virtual_mesh(monkeypatch):
     assert result["steps_per_call"] == 5      # the accelerator default
     assert result["value"] > 0
     assert result["mfu"] is None              # no peak table entry for cpu
+    assert result["mfu_ceiling_source"] is None
+    assert result["donated"] is True
+    assert result["config_source"] == "default"
+
+
+@pytest.mark.slow
+def test_run_bench_measured_mfu_ceiling(tmp_path, monkeypatch):
+    """A banked TRUSTED roofline for this device kind becomes the MFU
+    denominator; the spec-relative number rides alongside."""
+    spec = importlib.util.spec_from_file_location("bench", _BENCH)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    monkeypatch.setenv("BLUEFOG_MEASURED_DIR", str(tmp_path))
+    with open(tmp_path / "roofline_test.json", "w") as f:
+        json.dump({"ok": True, "device": "cpu",
+                   "mxu": [{"probe": "mxu_bf16_256",
+                            "flops_per_sec": 50e9,
+                            "trusted": True, "suspect": False}]}, f)
+    monkeypatch.setenv("BLUEFOG_BENCH_BATCH", "1")
+    monkeypatch.setenv("BLUEFOG_BENCH_ITERS", "1")
+    monkeypatch.setenv("BLUEFOG_BENCH_IMAGE_SIZE", "32")
+    monkeypatch.setenv("BLUEFOG_BENCH_CLASSES", "10")
+    monkeypatch.setenv("BLUEFOG_BENCH_STEPS_PER_CALL", "2")
+    result = mod.run_bench(True, {"probe_attempts": 1})
+    assert result["mfu"] is not None and result["mfu"] > 0
+    assert result["mfu_ceiling_source"] == "roofline:roofline_test.json"
+    assert result["mfu_spec"] is None         # cpu has no spec-sheet peak
 
 
 def test_run_bench_in_process_on_virtual_mesh(monkeypatch):
@@ -110,6 +141,33 @@ def test_run_bench_in_process_on_virtual_mesh(monkeypatch):
     assert result["vs_baseline"] >= 0
     assert result["n_chips"] == jax.device_count()
     assert result["probe_attempts"] == 0
+    # the graded artifact always reports the donation contract and embeds
+    # the banked on-TPU headline next to any CPU number
+    assert result["donated"] is True
+    assert result["fused_per_step_s"] > 0
+    bb = result["banked_best"]
+    assert bb is None or (bb["on_accelerator"] is True and bb["value"] > 0)
+
+
+@pytest.mark.slow
+def test_run_bench_fused_vs_spc1_probe(monkeypatch):
+    """BLUEFOG_BENCH_COMPARE_SPC1=1 makes the artifact carry the fused vs
+    single-step per-step comparison on the SAME workload."""
+    spec = importlib.util.spec_from_file_location("bench", _BENCH)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    monkeypatch.setenv("BLUEFOG_BENCH_BATCH", "1")
+    monkeypatch.setenv("BLUEFOG_BENCH_ITERS", "1")
+    monkeypatch.setenv("BLUEFOG_BENCH_STEPS_PER_CALL", "2")
+    monkeypatch.setenv("BLUEFOG_BENCH_IMAGE_SIZE", "32")
+    monkeypatch.setenv("BLUEFOG_BENCH_CLASSES", "10")
+    monkeypatch.setenv("BLUEFOG_BENCH_COMPARE_SPC1", "1")
+    result = mod.run_bench(False, {"probe_attempts": 0})
+    cmp = result["fused_vs_spc1"]
+    assert cmp is not None
+    assert cmp["spc1_per_step_s"] > 0 and cmp["fused_per_step_s"] > 0
+    assert cmp["fused_speedup"] > 0   # tiny CPU shapes: sign only, no bound
 
 
 def test_wire_stats_per_collective_accounting():
@@ -211,3 +269,44 @@ def test_best_banked_config_selection(tmp_path, monkeypatch):
     batch, spc, src = bench._best_banked_config()
     assert (batch, spc) == (256, 10)
     assert src == "bench_b256_r05x.json"
+
+
+def test_best_banked_config_matches_hardware(tmp_path, monkeypatch):
+    """A config proven on a different chip kind or slice size must not
+    steer (and OOM) the current run: filtered selection only adopts
+    artifacts whose recorded device/n_chips match, and artifacts that
+    never recorded them are unverifiable — skipped."""
+    spec = importlib.util.spec_from_file_location("bench_hw", _BENCH)
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    monkeypatch.setenv("BLUEFOG_MEASURED_DIR", str(tmp_path))
+
+    def write(name, **kw):
+        with open(tmp_path / name, "w") as f:
+            json.dump(kw, f)
+
+    # fastest artifact is from a bigger-HBM chip: must lose to the match
+    write("bench_v5p.json", ok=True, on_accelerator=True, value=4000.0,
+          device="TPU v5p", n_chips=1, batch_per_chip=512, steps_per_call=10)
+    write("bench_v5e_pod.json", ok=True, on_accelerator=True, value=3000.0,
+          device="TPU v5 lite", n_chips=8, batch_per_chip=256,
+          steps_per_call=10)
+    write("bench_v5e.json", ok=True, on_accelerator=True, value=1961.0,
+          device="TPU v5 lite", n_chips=1, batch_per_chip=64,
+          steps_per_call=5)
+    write("bench_nodev.json", ok=True, on_accelerator=True, value=9000.0,
+          batch_per_chip=1024, steps_per_call=20)   # no device recorded
+
+    batch, spc, src = bench._best_banked_config("TPU v5 lite", 1)
+    assert (batch, spc) == (64, 5)
+    assert src == "bench_v5e.json"
+    assert bench._best_banked_config("TPU v6e", 1) is None
+    # unfiltered selection (legacy behavior) still sees everything with a
+    # parseable config
+    assert bench._best_banked_config()[0] == 1024
+
+    # the banked_best EMBED (what rescue lines carry) is device-agnostic:
+    # it reports the best real hardware number, wherever it was measured
+    best = bench._banked_best_result()
+    assert best["value"] == 9000.0 and best["on_accelerator"] is True
+    assert best["source"] == "bench_nodev.json"

@@ -296,6 +296,68 @@ def test_battery_aborts_when_tunnel_dies_mid_run(paths, monkeypatch, tmp_path):
     assert (False, "hw_watch") in recorded
 
 
+def test_mid_battery_death_keeps_artifacts_banked_before_cut(
+        paths, monkeypatch):
+    """End-to-end rehearsal of the short-window failure mode: the tunnel
+    dies MID-battery (after the headline bench and the roofline banked,
+    during the sweep).  Incremental banking must hold — every artifact
+    captured before the cut survives on disk, parseable, exactly as the
+    next round's _best_banked_config/_measured_peak_flops expect; the
+    steps after the cut are skipped, and the battery summary records the
+    whole shape."""
+    for k, v in paths.items():
+        monkeypatch.setenv(k, v)
+    monkeypatch.setenv("BLUEFOG_HW_WATCH_SETTLE", "0")
+    mod = _load_watch(paths, monkeypatch, name="hw_watch_midcut")
+    os.makedirs(mod.MEASURED, exist_ok=True)
+    py = sys.executable
+    m = mod.MEASURED
+    bench_doc = json.dumps({"ok": True, "on_accelerator": True,
+                            "value": 1961.25, "batch_per_chip": 64,
+                            "steps_per_call": 5})
+    roof_doc = json.dumps({"ok": True, "device": "TPU v5 lite",
+                           "mxu": [{"probe": "mxu_bf16_8192",
+                                    "flops_per_sec": 150e12,
+                                    "trusted": True, "suspect": False}]})
+    roof_out = os.path.join(m, "roofline_rMID.json")
+    steps = [
+        # banked via stdout capture (the bench path)
+        ("bench", [py, "-c", f"print('{bench_doc}')"], 30,
+         os.path.join(m, "bench_rMID.json"), None),
+        # banked via --out-style self-write (the roofline path)
+        ("roofline",
+         [py, "-c",
+          f"import pathlib; pathlib.Path({roof_out!r}).write_text("
+          f"'{roof_doc}')"], 30, None, None),
+        # the tunnel dies here: the sweep wedges until its timeout
+        ("step_sweep", [py, "-c", "import time; time.sleep(60)"], 1,
+         None, None),
+        ("tpu_validate", [py, "-c", "print('{}')"], 30,
+         os.path.join(m, "tpu_validate_rMID.json"), None),
+    ]
+    monkeypatch.setattr(mod, "_battery_steps", lambda tag, stage=0: steps)
+    monkeypatch.setattr(mod, "probe", lambda *a, **k: False)  # stays dead
+    monkeypatch.setattr(mod._bench, "write_probe_state",
+                        lambda *a, **k: None)
+    summary = mod.run_battery("rMID", stub=False, no_commit=True)
+
+    # pre-cut artifacts survived, parseable, with the banked content
+    assert json.load(open(os.path.join(m, "bench_rMID.json")))["value"] \
+        == 1961.25
+    assert json.load(open(roof_out))["mxu"][0]["trusted"] is True
+    # post-cut: skipped, never written
+    assert summary["steps"]["step_sweep"]["rc"] == "timeout"
+    assert summary["steps"]["tpu_validate"]["rc"] == \
+        "skipped: tunnel unreachable"
+    assert not os.path.exists(os.path.join(m, "tpu_validate_rMID.json"))
+    # and the banked artifacts are exactly what the next round consumes
+    monkeypatch.setenv("BLUEFOG_MEASURED_DIR", m)
+    bench = _load_bench()
+    assert bench._best_banked_config() == (64, 5, "bench_rMID.json")
+    assert bench._measured_peak_flops("TPU v5 lite") == \
+        (150e12, "roofline_rMID.json")
+
+
 def test_battery_continues_when_tunnel_survives_timeout(paths, monkeypatch):
     """Same wedge, but the re-probe says the tunnel is alive: the next
     step still runs (one lost step, not a lost battery)."""
@@ -351,6 +413,7 @@ def test_bench_fast_path_ignores_full_schedule_attempts(paths, monkeypatch):
     assert info["probe_attempts"] == 1
 
 
+@pytest.mark.slow
 def test_bench_waits_longer_when_tunnel_busy_but_up(paths, monkeypatch):
     """Lock held + fresh ok=True state (battery mid-flight on a LIVE
     tunnel): bench must take the extended wait rather than immediately

@@ -508,3 +508,15 @@ class TestWireCompression:
         bf.set_topology(tu.RingGraph(N), is_weighted=True)
         with pytest.raises(ValueError, match="unknown wire codec"):
             bf.neighbor_allreduce(rank_tensor(), wire="fp4")
+
+    def test_non_string_wire_rejected(self):
+        """A non-str wire (an int bit-width, a codec tuple) must fail the
+        same self-explaining ValueError as an unknown codec, not an
+        AttributeError from wire.partition deep in the parser."""
+        from bluefog_tpu.ops.collectives import _parse_wire
+        bf.set_topology(tu.RingGraph(N), is_weighted=True)
+        for bad in (8, b"int8", ("int8", 64), 0.5):
+            with pytest.raises(ValueError, match="unknown wire codec"):
+                _parse_wire(bad)
+        with pytest.raises(ValueError, match="unknown wire codec"):
+            bf.neighbor_allreduce(rank_tensor(), wire=8)

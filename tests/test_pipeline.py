@@ -9,6 +9,10 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from bluefog_tpu.parallel.pipeline import last_stage_value, pipeline_apply
 
+# compile-heavy: every case traces+compiles an S-stage scheduled program
+# and its autodiff transpose — minutes of XLA work on the fast-tier box
+pytestmark = pytest.mark.slow
+
 S = 4       # stages
 M = 6       # microbatches
 B, D = 2, 5

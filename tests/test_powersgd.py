@@ -114,9 +114,13 @@ def test_powersgd_rejects_bad_rank():
         bfopt.powersgd_allreduce(optax.sgd(0.1), compression_rank=0)
 
 
+@pytest.mark.slow
 def test_powersgd_wire_bytes_cut_on_v5e():
     """The compiled TPU schedule allreduces the rank-r factors, not the
-    full matrix: payload ~ (m + k) * r * 4 bytes vs m * k * 4."""
+    full matrix: payload ~ (m + k) * r * 4 bytes vs m * k * 4.
+
+    slow: AOT-compiling the two v5e train steps dominates the fast tier
+    (460 s of XLA compile on the CPU-only CI box)."""
     from jax.experimental import topologies
 
     try:

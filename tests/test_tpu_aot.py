@@ -23,6 +23,10 @@ from bluefog_tpu import topology as tu
 from bluefog_tpu.ops import ring_attention
 from bluefog_tpu.ops import ulysses as ops_ulysses
 
+# compile-heavy: AOT-compiles real v5e TPU schedules (10-15 s each when
+# the topology backend is available) — the full-tier overlap proofs
+pytestmark = pytest.mark.slow
+
 N = 8
 
 

@@ -105,6 +105,9 @@ def test_perf_fill_renders_and_is_idempotent(tmp_path, monkeypatch):
     assert "41.0%" in filled                      # MFU formatted
     assert "overlap fraction 0.75" in filled
     assert filled.count(pf.BEGIN) == 1
+    # the artifact above predates batch/steps_per_call: the config suffix
+    # must be omitted entirely, not rendered as a literal "bNone·kNone"
+    assert "bNone" not in filled and "kNone" not in filled
     # idempotent: writing again replaces the marked block, not appends
     open_orig = pf.PERF
     try:
@@ -127,3 +130,34 @@ def test_perf_fill_renders_and_is_idempotent(tmp_path, monkeypatch):
         assert healed.count(pf.END) == 1
     finally:
         pf.PERF = open_orig
+
+
+def test_perf_fill_renders_config_suffix_and_roofline(tmp_path, monkeypatch):
+    """Artifacts WITH the r06 fields: the headline row carries the
+    b<batch>·k<steps> config, and a banked roofline renders with its
+    trusted/suspect verdicts."""
+    measured = tmp_path / "measured"
+    measured.mkdir()
+    (measured / "bench_rY.json").write_text(json.dumps({
+        "ok": True, "value": 1961.25, "unit": "img/s/chip", "mfu": 0.12,
+        "vs_baseline": 7.28, "on_accelerator": True, "device": "TPU v5e",
+        "batch_per_chip": 64, "steps_per_call": 5}))
+    (measured / "roofline_rY.json").write_text(json.dumps({
+        "ok": True, "device": "TPU v5 lite",
+        "mxu": [
+            {"probe": "mxu_bf16_8192", "tflops": 150.2,
+             "flops_per_sec": 150.2e12, "trusted": True, "suspect": False},
+            {"probe": "mxu_bf16_4096", "tflops": 641.0,
+             "flops_per_sec": 641e12, "trusted": False, "suspect": True,
+             "note": "rate tripwire"},
+        ],
+        "hbm": [{"probe": "hbm_rw_1024MiB", "gbps": 780.0,
+                 "dispatch_corrected_gbps": 800.0,
+                 "trusted": True, "suspect": False}]}))
+    monkeypatch.setenv("BLUEFOG_MEASURED_DIR", str(measured))
+    pf = _load("perf_fill")
+    filled = pf.fill("rY", dry_run=True)
+    assert "b64·k5" in filled
+    assert "150.2 TFLOP/s — trusted" in filled
+    assert "**SUSPECT, rejected**" in filled
+    assert "780.0 GB/s (dispatch-corrected 800.0)" in filled

@@ -193,6 +193,12 @@ def _battery_steps(tag: str, stage: int = 0) -> list:
         ("chip_calibrate",
          [py, os.path.join(REPO, "tools", "chip_calibrate.py")], 2400,
          os.path.join(m, f"chip_calibrate_{tag}.json"), None),
+        # the tripwired MFU ceiling (bench._measured_peak_flops consumes
+        # only trusted probes); cheap — two matmul sizes + an HBM pass
+        ("roofline",
+         [py, os.path.join(REPO, "tools", "roofline.py"),
+          "--out", os.path.join(m, f"roofline_{tag}.json")], 2400,
+         None, None),
     ]
     if os.path.exists(lm):
         # batch 2: the XLA (non-flash) attention materializes [B,T,H,T]
@@ -252,6 +258,10 @@ def _rehearsal_steps(tag: str) -> list:
         ("chip_calibrate",
          [py, os.path.join(REPO, "tools", "chip_calibrate.py"), "--smoke"],
          600, os.path.join(m, f"chip_calibrate_{tag}.json"), None),
+        ("roofline",
+         [py, os.path.join(REPO, "tools", "roofline.py"), "--smoke",
+          "--out", os.path.join(m, f"roofline_{tag}.json")], 600,
+         None, None),
         ("lm_bench",
          [py, os.path.join(REPO, "tools", "lm_bench.py"),
           "--virtual-cpu", "--smoke", "--no-pallas",

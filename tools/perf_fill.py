@@ -50,11 +50,15 @@ def render(tag):
         acc = "TPU" if bench.get("on_accelerator") else "CPU FALLBACK"
         # batch/steps_per_call alongside the value: the config may adopt
         # a banked-best shape across rounds (bench._best_banked_config),
-        # so the headline must say what shape produced the number
-        cfg = (f"b{bench.get('batch_per_chip')}"
-               f"·k{bench.get('steps_per_call')}")
+        # so the headline must say what shape produced the number.
+        # Pre-r05 artifacts predate those fields — omit the suffix rather
+        # than render a literal "bNone·kNone".
+        batch = bench.get("batch_per_chip")
+        spc = bench.get("steps_per_call")
+        cfg = f", b{batch}·k{spc}" if batch is not None and spc is not None \
+            else ""
         rows.append(
-            f"| ResNet-50 synthetic ({acc} {dev}, {cfg}) | "
+            f"| ResNet-50 synthetic ({acc} {dev}{cfg}) | "
             f"**{bench.get('value')} {bench.get('unit', '')}** | "
             f"MFU {_fmt_mfu(bench.get('mfu'))} | "
             f"vs V100 baseline x{bench.get('vs_baseline')} |")
@@ -92,6 +96,31 @@ def render(tag):
                 lines.append(
                     f"- `{e['probe']}`: {extra}, dispatch overhead "
                     f"{e.get('dispatch_overhead_ms', '?')} ms{flag}")
+            lines.append("")
+
+    roof = _load("roofline", tag)
+    if roof and isinstance(roof, dict) and roof.get("ok"):
+        probes = [p for p in roof.get("mxu", []) + roof.get("hbm", [])
+                  if isinstance(p, dict) and "probe" in p]
+        if probes:
+            lines += ["Trusted roofline (`tools/roofline.py`, tripwired — "
+                      "only `trusted` rows may become MFU denominators):",
+                      ""]
+            for p in probes:
+                if "tflops" in p:
+                    extra = f"{p['tflops']} TFLOP/s"
+                elif "gbps" in p:
+                    extra = (f"{p['gbps']} GB/s (dispatch-corrected "
+                             f"{p.get('dispatch_corrected_gbps', '?')})")
+                else:
+                    extra = "no rate (tripwired before timing)"
+                if p.get("suspect"):
+                    flag = " — **SUSPECT, rejected**"
+                elif p.get("trusted"):
+                    flag = " — trusted"
+                else:
+                    flag = ""
+                lines.append(f"- `{p['probe']}`: {extra}{flag}")
             lines.append("")
 
     sweep = _load("step_sweep", tag)

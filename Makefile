@@ -5,7 +5,8 @@
 PY ?= python
 
 .PHONY: test test-fast test_basic test_ops test_win_ops test_optimizer \
-	test_hier test_native test_examples verify native clean hw-watch
+	test_hier test_native test_examples verify native clean hw-watch \
+	obs-smoke
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -68,6 +69,26 @@ test_examples:
 	$(PY) examples/pipeline_lm.py --virtual-cpu --steps 30 --hetero
 	$(PY) examples/llm_3d.py --virtual-cpu --steps 40
 	$(PY) examples/elastic_restart.py --virtual-cpu --steps 60
+
+# observability smoke: both post-processing tools against the committed
+# fixtures, then a schema check on their output JSON — exporter format
+# drift fails here (and in tier-1, via the same fixtures in
+# tests/test_trace_tools.py / tests/test_metrics.py)
+obs-smoke:
+	$(PY) tools/trace_analyze.py tests/fixtures/obs_trace.trace.json \
+		--out /tmp/obs_trace_split.json
+	$(PY) tools/metrics_report.py \
+		tests/fixtures/metrics_host0.metrics.jsonl \
+		tests/fixtures/metrics_host1.metrics.jsonl \
+		--out /tmp/obs_metrics_report.json
+	$(PY) -c "import json; \
+		d = json.load(open('/tmp/obs_trace_split.json')); \
+		assert d['ok'] and all(k in d for k in ('wall_ms', 'compute_ms', \
+		'comm_ms', 'comm_exposed_ms', 'overlap_fraction', 'idle_ms')), d; \
+		r = json.load(open('/tmp/obs_metrics_report.json')); \
+		assert r['ok'] and r['n_hosts'] == 2 and all(k in r for k in \
+		('metrics', 'series', 'summary')), r; \
+		print('obs-smoke OK')"
 
 # background TPU-tunnel watcher: probes every ~10 min, runs the full
 # measurement battery unattended on the first success (tools/hw_watch.py)

@@ -496,8 +496,9 @@ def run_bench(on_accelerator: bool, probe_info: dict) -> dict:
         if f > 0:
             xla_flops_per_call = f
         step = compiled
+        aot_ok = True
     except Exception:
-        pass                      # fall back to the jit path
+        aot_ok = False            # fall back to the jit path
     # MFU uses analytic *model* FLOPs (the convention): ResNet-50 fwd
     # ~4.09 GFLOP/img, train ~3x.  XLA's cost_analysis count (reported
     # alongside as xla_call_flops) covers the whole steps_per_call-step
@@ -516,6 +517,20 @@ def run_bench(on_accelerator: bool, probe_info: dict) -> dict:
         dist_params, dist_state, loss = step(dist_params, dist_state, data)
     bf.hard_sync(loss)
     dt = time.perf_counter() - t0
+
+    # feed the telemetry registry from the trusted (hard-synced) totals:
+    # one amortized fused-call observation per iter — per-call host times
+    # are dispatch times under async dispatch, not step times.  The jit
+    # fallback path self-instruments (make_train_step wraps the step), so
+    # only the AOT executable needs explicit feeding.
+    try:
+        from bluefog_tpu.utils import metrics as bfmetrics
+        if aot_ok:
+            for _ in range(iters):
+                bfmetrics.record_step(dt / iters, steps=steps_per_call,
+                                      donated=True, fused_k=steps_per_call)
+    except Exception:
+        bfmetrics = None
 
     total_imgs = iters * steps_per_call * batch * n
     imgs_per_sec = total_imgs / dt
@@ -562,6 +577,43 @@ def run_bench(on_accelerator: bool, probe_info: dict) -> dict:
     mfu = (flops_per_call * iters / dt / (peak * n)) if peak else None
     mfu_spec = (flops_per_call * iters / dt / (peak_spec * n)) \
         if peak_spec else None
+
+    # live-telemetry summary for the graded artifact: step-time histogram
+    # percentiles, HLO-derived comm bytes (trusted: parsed from the
+    # compiled program, not timed), compile-cache hit ratio, and one
+    # consensus-probe sample on the final params.  Every piece is guarded:
+    # a telemetry failure must never cost the headline measurement.
+    metrics_summary = None
+    try:
+        metrics_summary = bfmetrics.metrics_summary() if bfmetrics else None
+    except Exception:
+        metrics_summary = None
+    if metrics_summary is not None:
+        try:
+            import sys as _sys
+            tools_dir = os.path.join(
+                os.path.dirname(os.path.abspath(__file__)), "tools")
+            if tools_dir not in _sys.path:
+                _sys.path.insert(0, tools_dir)
+            from strategy_bench import wire_stats
+            counts, wire_b = wire_stats(compiled.as_text())
+            metrics_summary["comm"] = {
+                "per_call_bytes_per_chip": int(sum(wire_b.values())),
+                "collectives": counts,
+            }
+        except Exception:
+            pass
+        try:
+            from bluefog_tpu import diagnostics as bfdiag
+            d = bfdiag.diagnose_consensus(dist_params)
+            metrics_summary["consensus"] = {
+                "distance_max": d["consensus_distance_max"],
+                "distance_mean": d["consensus_distance_mean"],
+                "neighbor_disagreement_max": d["neighbor_disagreement_max"],
+            }
+        except Exception:
+            pass
+
     return {
         "metric": "resnet50_synthetic_imgs_per_sec_per_chip",
         "value": round(per_chip, 2),
@@ -585,6 +637,7 @@ def run_bench(on_accelerator: bool, probe_info: dict) -> dict:
         "step_flops": flops_per_call / steps_per_call,
         "xla_call_flops": xla_flops_per_call,
         "banked_best": _banked_best_result(),
+        "metrics_summary": metrics_summary,
         **probe_info,
     }
 

@@ -25,6 +25,8 @@ from . import utils
 from .utils import (
     timeline_start_activity, timeline_end_activity, timeline_context,
     start_timeline, stop_timeline,
+    start_metrics, stop_metrics, metrics_summary,
+    render_prometheus, start_http_server, stop_http_server,
     broadcast_parameters, allreduce_parameters, broadcast_optimizer_state,
 )
 from .parallel import (
@@ -49,5 +51,7 @@ from .api import (
     pair_gossip, hierarchical_neighbor_allreduce,
     barrier, synchronize, poll, hard_sync, resolve_schedule, shard_distributed,
 )
+from . import diagnostics
+from .diagnostics import diagnose_consensus, consensus_distance
 
 __version__ = "0.1.0"

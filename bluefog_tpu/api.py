@@ -27,6 +27,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from . import ops
 from .parallel import context as _mesh
 from .schedule import CommSchedule, compile_from_weights
+from .utils import metrics as _metrics
 from .utils import timeline as _tl
 
 __all__ = [
@@ -40,7 +41,9 @@ __all__ = [
 def _dispatch(op_name, fn, *args):
     """Dispatch one eager op under a host timeline span (no-op when the
     timeline is off) — the per-op activities the reference's negotiation
-    loop records (``test/timeline_test.py:54-117``)."""
+    loop records (``test/timeline_test.py:54-117``) — and count the call +
+    payload bytes in the metrics registry."""
+    _metrics.record_op(op_name, args)
     with _tl.op_span(op_name):
         return fn(*args)
 

@@ -1,0 +1,161 @@
+"""Consensus-health probes: is the gossip actually contracting?
+
+Bluefog's convergence story (PAPER.md §1) rests on the mixing matrix
+pulling every rank's iterate toward the network average — a property that
+silently breaks when a topology is mis-weighted, a dynamic schedule skips
+ranks, or an async window goes stale.  The reference could only see this
+after the fact, via timeline forensics; this module computes the health
+signals *live*, on device, with the same collectives the training step
+already uses:
+
+* **consensus distance** ``‖x_i − x̄‖`` per rank (vs the exact network
+  average via ``pmean``) — the quantity whose contraction the paper's
+  bounds are about,
+* **max neighbor disagreement** ``max_j ‖x_i − x_j‖`` over each rank's
+  in-neighbors (a localized, topology-aware view: a single wedged edge
+  shows up here before it moves the global distance),
+* **window staleness depth** — per named window, how many deliveries sit
+  unconsumed in the mailboxes (``win_put`` since the last ``win_update``).
+
+``diagnose_consensus(params)`` is the one-shot API; the train-step
+builders' ``metrics_every_k`` hook calls the same compiled program on the
+step's *outputs* every k-th call, so sampling neither touches donated
+input buffers nor forces a retrace (the probe compiles once, during
+warmup, through the shared program cache).
+
+Probe cost: one flatten + two collective chains over a single f32 vector
+the size of the float parameters — fine at a sampling cadence, not free
+every step.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from . import ops
+from .parallel import context as _mesh
+from .schedule import CommSchedule
+from .utils import metrics as _metrics
+
+__all__ = ["diagnose_consensus", "consensus_distance", "window_staleness"]
+
+
+def _float_mask(tree) -> tuple:
+    """Static signature of the float leaves (shape, dtype) — the program
+    cache key component; non-float leaves (step counters) are ignored."""
+    sig = []
+    for leaf in jax.tree.leaves(tree):
+        dt = getattr(leaf, "dtype", None)
+        if dt is not None and jnp.issubdtype(dt, jnp.floating):
+            sig.append((tuple(leaf.shape), str(dt)))
+    return tuple(sig)
+
+
+def _flat_f32(tree) -> jax.Array:
+    """Per-rank float leaves as one f32 vector (zeros(1) when none)."""
+    leaves = [leaf.reshape(-1).astype(jnp.float32)
+              for leaf in jax.tree.leaves(tree)
+              if jnp.issubdtype(leaf.dtype, jnp.floating)]
+    return jnp.concatenate(leaves) if leaves else jnp.zeros((1,), jnp.float32)
+
+
+def _probe_program(ctx, sched: Optional[CommSchedule], sig):
+    """Compiled probe: distributed params -> (distance [n], disagreement [n])."""
+    in_deg = (np.asarray([len(s) for s in sched.in_neighbors], np.int32)
+              if sched is not None else None)
+
+    def per_rank(tree):
+        v = _flat_f32(jax.tree.map(lambda x: x[0], tree))
+        vbar = lax.pmean(v, "rank")
+        dist = jnp.sqrt(jnp.sum((v - vbar) ** 2))
+        if sched is not None and sched.max_in_degree > 0:
+            slots = max(sched.max_in_degree, 1)
+            g = ops.neighbor_allgather(v, sched, axis="rank")
+            g = g.reshape(slots, v.shape[0])
+            diffs = jnp.sqrt(jnp.sum((g - v[None, :]) ** 2, axis=1))
+            # trailing slots on low-degree ranks are zero-filled, not
+            # neighbor values — mask by this rank's static in-degree
+            mydeg = jnp.asarray(in_deg)[lax.axis_index("rank")]
+            disagree = jnp.max(
+                jnp.where(jnp.arange(slots) < mydeg, diffs, 0.0))
+        else:
+            disagree = jnp.zeros((), jnp.float32)
+        return dist[None], disagree[None]
+
+    def build():
+        return jax.jit(jax.shard_map(
+            per_rank, mesh=ctx.mesh, in_specs=P("rank"),
+            out_specs=(P("rank"), P("rank"))))
+
+    return _mesh.cached_program(
+        ("diag-consensus", sched, ctx.mesh, sig), build)
+
+
+def consensus_distance(params: Any,
+                       schedule: Optional[CommSchedule] = None) -> np.ndarray:
+    """Per-rank ``‖x_i − x̄‖`` over the float leaves of distributed
+    ``params`` (leading rank axis)."""
+    return diagnose_consensus(params, schedule=schedule,
+                              record=False)["consensus_distance"]
+
+
+def window_staleness() -> Dict[str, int]:
+    """Unconsumed deliveries per named window (puts/accs since the last
+    ``win_update``): ``{window_name: max_mailbox_depth}``."""
+    from .parallel import windows as _win
+    out = {}
+    for name, entry in _win._registry.items():
+        out[name] = int(entry.version.max()) if entry.version.size else 0
+    return out
+
+
+def diagnose_consensus(params: Any, *,
+                       schedule: Optional[CommSchedule] = None,
+                       record: bool = True) -> Dict[str, Any]:
+    """One health sample over distributed ``params``.
+
+    Returns consensus distance (per-rank array + max/mean), max neighbor
+    disagreement under ``schedule`` (default: the context's static
+    schedule; skipped when no topology is set), and window staleness.
+    ``record=True`` also publishes the scalars as registry gauges so the
+    exporters pick them up.
+    """
+    ctx = _mesh.get_context()
+    if schedule is None:
+        try:
+            schedule = ctx.static_schedule()
+        except RuntimeError:
+            schedule = None
+    fn = _probe_program(ctx, schedule, _float_mask(params))
+    dist, disagree = fn(params)
+    dist = np.asarray(dist)
+    disagree = np.asarray(disagree)
+    staleness = window_staleness()
+    out = {
+        "consensus_distance": dist,
+        "consensus_distance_max": float(dist.max()),
+        "consensus_distance_mean": float(dist.mean()),
+        "neighbor_disagreement": disagree,
+        "neighbor_disagreement_max": float(disagree.max()),
+        "window_staleness": staleness,
+    }
+    if record:
+        _metrics.gauge("bluefog_consensus_distance_max",
+                       "max over ranks of ||x_i - mean(x)||"
+                       ).set(out["consensus_distance_max"])
+        _metrics.gauge("bluefog_consensus_distance_mean",
+                       "mean over ranks of ||x_i - mean(x)||"
+                       ).set(out["consensus_distance_mean"])
+        _metrics.gauge("bluefog_neighbor_disagreement_max",
+                       "max over ranks/edges of ||x_i - x_j||"
+                       ).set(out["neighbor_disagreement_max"])
+        if staleness:
+            _metrics.gauge("bluefog_window_staleness_max",
+                           "max unconsumed mailbox deliveries"
+                           ).set(max(staleness.values()))
+    return out

@@ -48,6 +48,7 @@ from . import fusion, ops
 from .ops import windows as wops
 from .parallel import context as _mesh
 from .schedule import CommSchedule
+from .utils import metrics as _metrics
 from .utils.timeline import named_span
 
 Axis = str
@@ -1214,6 +1215,80 @@ TRAIN_STEP_DONATE_ARGNUMS = (0, 1)
 STATEFUL_TRAIN_STEP_DONATE_ARGNUMS = (0, 1, 2)
 
 
+class _InstrumentedStep:
+    """Telemetry shim around the jitted train step.
+
+    Feeds the metrics registry from the host side of every call: per-call
+    wall time (EWMA gauge + histogram), the fused-k/donation flags, and
+    the retrace sentinel — the jit cache growing after warmup means the
+    step recompiled in steady state.  With ``metrics_every_k`` set it also
+    samples :func:`bluefog_tpu.diagnostics.diagnose_consensus` on the
+    step's *output* params (never the donated inputs) on the first call —
+    so the probe compiles inside the warmup window — and then on every
+    k-th call.  Everything else (``.lower`` for AOT, ``._cache_size`` in
+    tests) delegates to the wrapped jit function untouched.
+    """
+
+    def __init__(self, fn, *, steps_per_call: int, donated: bool,
+                 metrics_every_k: Optional[int] = None, warmup: int = 2):
+        self._fn = fn
+        self._steps_per_call = steps_per_call
+        self._donated = donated
+        self._metrics_every_k = metrics_every_k
+        self._warmup = max(int(warmup), 1)
+        self._calls = 0
+        self._jit_cache_baseline: Optional[int] = None
+
+    def __getattr__(self, name):
+        fn = self.__dict__.get("_fn")
+        if fn is None:
+            raise AttributeError(name)
+        return getattr(fn, name)
+
+    def _jit_cache_len(self) -> Optional[int]:
+        try:
+            return self._fn._cache_size()
+        except Exception:
+            return None
+
+    def __call__(self, *args, **kwargs):
+        import time as _time
+        t0 = _time.perf_counter()
+        out = self._fn(*args, **kwargs)
+        dt = _time.perf_counter() - t0
+        self._calls += 1
+        _metrics.record_step(dt, steps=self._steps_per_call,
+                             donated=self._donated,
+                             fused_k=self._steps_per_call)
+        k = self._metrics_every_k
+        if k and (self._calls == 1 or self._calls % k == 0):
+            from . import diagnostics as _diag
+            _diag.diagnose_consensus(out[0])
+        if self._calls >= self._warmup:
+            size = self._jit_cache_len()
+            if (_metrics.in_steady_state() and size is not None
+                    and self._jit_cache_baseline is not None
+                    and size > self._jit_cache_baseline):
+                _metrics.note_retrace(
+                    f"jit cache grew {self._jit_cache_baseline} -> {size}")
+            self._jit_cache_baseline = size
+            _metrics.mark_steady_state(True)
+        _metrics.sample(step=self._calls)
+        return out
+
+
+def _check_metrics_every_k(metrics_every_k, strategy):
+    if metrics_every_k is None:
+        return
+    if metrics_every_k < 1:
+        raise ValueError("metrics_every_k must be >= 1")
+    if strategy.axes != ("rank",):
+        raise ValueError(
+            "metrics_every_k requires a rank-axis strategy; the consensus "
+            "probe runs over the 1-D mesh — call diagnose_consensus "
+            "manually for hierarchical strategies")
+
+
 def make_train_step(
     grad_fn: Callable[[Any, Any], Tuple[jax.Array, Any]],
     strategy: DecentralizedOptimizer,
@@ -1221,6 +1296,8 @@ def make_train_step(
     steps_per_call: int = 1,
     reuse_batch: bool = False,
     donate: bool = True,
+    metrics_every_k: Optional[int] = None,
+    metrics_warmup: int = 2,
 ):
     """Build the jitted SPMD training step over the context mesh.
 
@@ -1251,7 +1328,15 @@ def make_train_step(
     reading the pre-step params/state after the call; by default both are
     donated (:data:`TRAIN_STEP_DONATE_ARGNUMS`) so XLA updates them in
     place instead of round-tripping fresh HBM allocations.
+
+    ``metrics_every_k=k`` samples the consensus-health probes
+    (:mod:`bluefog_tpu.diagnostics`) every k-th call, on the step's output
+    params — compatible with donation, and compiled during warmup so
+    steady state sees zero extra compilations.  ``metrics_warmup`` is the
+    call count after which the retrace sentinel arms (every builder call
+    always feeds step-time/flag metrics; the registry is cheap).
     """
+    _check_metrics_every_k(metrics_every_k, strategy)
     ctx = _mesh.get_context()
     mesh = ctx.mesh if strategy.axes == ("rank",) else ctx.mesh_2d
     spec = P("rank") if strategy.axes == ("rank",) else P(("machine", "local"))
@@ -1270,10 +1355,13 @@ def make_train_step(
     # donate params/state: the update is functional but the caller always
     # rebinds both, so XLA can reuse their buffers in place (halves peak
     # parameter memory for large models)
-    return jax.jit(
+    step = jax.jit(
         jax.shard_map(per_rank, mesh=mesh, in_specs=(spec, spec, spec),
                       out_specs=(spec, spec, spec)),
         donate_argnums=TRAIN_STEP_DONATE_ARGNUMS if donate else ())
+    return _InstrumentedStep(
+        step, steps_per_call=steps_per_call, donated=donate,
+        metrics_every_k=metrics_every_k, warmup=metrics_warmup)
 
 
 def _stateful_per_rank(grad_fn, strategy, steps_per_call, sync,
@@ -1325,6 +1413,8 @@ def make_stateful_train_step(
     donate: bool = True,
     state_sync: Optional[str] = None,
     state_sync_schedule: Optional[CommSchedule] = None,
+    metrics_every_k: Optional[int] = None,
+    metrics_warmup: int = 2,
 ):
     """:func:`make_train_step` for networks with non-parameter state (BN
     running stats, EMA shadows — haiku's ``transform_with_state``, flax's
@@ -1342,10 +1432,12 @@ def make_stateful_train_step(
     Integer leaves (counters) are never averaged.  Syncing requires a
     rank-axis strategy (1-D mesh).
 
-    ``steps_per_call``, ``reuse_batch``, and ``donate`` behave exactly as in
-    :func:`make_train_step` (donation here covers params, net state, and
-    optimizer state — :data:`STATEFUL_TRAIN_STEP_DONATE_ARGNUMS`).
+    ``steps_per_call``, ``reuse_batch``, ``donate``, ``metrics_every_k``,
+    and ``metrics_warmup`` behave exactly as in :func:`make_train_step`
+    (donation here covers params, net state, and optimizer state —
+    :data:`STATEFUL_TRAIN_STEP_DONATE_ARGNUMS`).
     """
+    _check_metrics_every_k(metrics_every_k, strategy)
     ctx = _mesh.get_context()
     mesh = ctx.mesh if strategy.axes == ("rank",) else ctx.mesh_2d
     spec = P("rank") if strategy.axes == ("rank",) else P(("machine", "local"))
@@ -1378,7 +1470,10 @@ def make_stateful_train_step(
 
     inner = _stateful_per_rank(grad_fn, strategy, steps_per_call, sync,
                                reuse_batch=reuse_batch)
-    return jax.jit(
+    step = jax.jit(
         jax.shard_map(inner, mesh=mesh, in_specs=(spec,) * 4,
                       out_specs=(spec,) * 4),
         donate_argnums=STATEFUL_TRAIN_STEP_DONATE_ARGNUMS if donate else ())
+    return _InstrumentedStep(
+        step, steps_per_call=steps_per_call, donated=donate,
+        metrics_every_k=metrics_every_k, warmup=metrics_warmup)

@@ -93,11 +93,17 @@ def cached_program(key, build: Callable[[], Callable]) -> Callable:
     deadlock).  Two threads racing on one key both build; the first insert
     wins so every caller dispatches the same executable.
     """
+    from ..utils import metrics as _metrics
     with _lock:
         fn = _program_cache.get(key)
         if fn is not None:
             _program_stats["hits"] += 1
-            return fn
+            hit = True
+        else:
+            hit = False
+    _metrics.note_cache_event(hit, key)
+    if hit:
+        return fn
     fn = build()
     with _lock:
         _program_stats["misses"] += 1
@@ -159,7 +165,11 @@ def init(
     global _context
     from ..utils.config import setup_logging, env_int
     from ..utils.timeline import maybe_start_from_env
+    from ..utils import metrics as _metrics
     setup_logging()
+    # a fresh init starts a fresh warmup: the retrace sentinel must not
+    # carry a previous training run's steady-state declaration
+    _metrics.mark_steady_state(False)
     if devices is None:
         if platform is not None:
             # An explicit platform must also *restrict* backend init: plugins
@@ -200,6 +210,7 @@ def init(
     if nodes_per_machine is None:
         nodes_per_machine = jax.local_device_count() if jax.process_count() > 1 else n
     maybe_start_from_env()
+    _metrics.maybe_start_from_env()
     if n % nodes_per_machine != 0:
         raise ValueError(
             f"device count {n} not divisible by nodes_per_machine {nodes_per_machine}")
@@ -254,7 +265,10 @@ def shutdown() -> None:
     (``operations.cc:464-473``)."""
     global _context
     from ..utils.timeline import stop_timeline
+    from ..utils import metrics as _metrics
     stop_timeline()
+    _metrics.stop_metrics()   # final JSONL sample + close
+    _metrics.mark_steady_state(False)
     clear_program_cache()     # executables pin device buffers past shutdown
     with _lock:
         _context = None

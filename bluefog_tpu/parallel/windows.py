@@ -30,6 +30,7 @@ from jax.sharding import PartitionSpec as P
 
 from ..ops import windows as wops
 from ..schedule import CommSchedule, compile_from_weights
+from ..utils import metrics as _metrics
 from . import context as _mesh
 
 __all__ = [
@@ -198,6 +199,9 @@ def _move(kind: str, tensor_or_none, name: str, dst_weights,
           wire=None) -> None:
     ctx = _mesh.get_context()
     entry = _entry(name)
+    _metrics.record_op(
+        "win_" + kind,
+        () if tensor_or_none is None else (tensor_or_none,))
     sched = (_dst_schedule(entry.sched, dst_weights)
              if dst_weights is not None else entry.sched)
     slots = entry.window.recv.shape[1]

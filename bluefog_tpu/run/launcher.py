@@ -90,6 +90,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--timeline-filename", default=None,
                    help="enable timeline tracing to this path prefix "
                         "(sets BLUEFOG_TIMELINE; reference: bfrun flag)")
+    p.add_argument("--metrics-filename", default=None,
+                   help="enable the JSONL metrics log to this path prefix "
+                        "(sets BLUEFOG_METRICS; merge per-host files with "
+                        "tools/metrics_report.py)")
+    p.add_argument("--metrics-port", type=int, default=None,
+                   help="serve Prometheus text exposition on this port "
+                        "(sets BLUEFOG_METRICS_PORT; endpoint: /metrics)")
     p.add_argument("-x", "--env", action="append", default=[],
                    help="extra NAME=VALUE env for the child (repeatable)")
     p.add_argument("--no-xla-tuning", action="store_true",
@@ -139,6 +146,10 @@ def _child_env(args) -> dict:
         env[k] = v
     if args.timeline_filename:
         env["BLUEFOG_TIMELINE"] = args.timeline_filename
+    if args.metrics_filename:
+        env["BLUEFOG_METRICS"] = args.metrics_filename
+    if args.metrics_port is not None:
+        env["BLUEFOG_METRICS_PORT"] = str(args.metrics_port)
     if not args.no_xla_tuning:
         from ..utils.config import (
             RECOMMENDED_TPU_XLA_FLAGS, looks_like_tpu_environment)

@@ -1,7 +1,12 @@
-"""Utilities: timeline tracing, parameter sync helpers, env config."""
+"""Utilities: timeline tracing, live metrics, parameter sync, env config."""
 from .timeline import (
     timeline_start_activity, timeline_end_activity, timeline_context,
     start_timeline, stop_timeline,
+)
+from .metrics import (
+    counter, gauge, histogram, snapshot, reset_metrics, metrics_summary,
+    start_metrics, stop_metrics, sample,
+    render_prometheus, start_http_server, stop_http_server,
 )
 from .utility import (
     broadcast_parameters, allreduce_parameters, broadcast_optimizer_state,
@@ -12,6 +17,9 @@ from .watchdog import synchronize_with_watchdog
 __all__ = [
     "timeline_start_activity", "timeline_end_activity", "timeline_context",
     "start_timeline", "stop_timeline",
+    "counter", "gauge", "histogram", "snapshot", "reset_metrics",
+    "metrics_summary", "start_metrics", "stop_metrics", "sample",
+    "render_prometheus", "start_http_server", "stop_http_server",
     "broadcast_parameters", "allreduce_parameters",
     "broadcast_optimizer_state",
     "env_flag", "env_int", "env_float",

@@ -76,17 +76,40 @@ def start_timeline(path_prefix: str, with_device_trace: bool = True) -> bool:
 
 
 def stop_timeline() -> Optional[str]:
-    """Flush the activity JSON (+ device trace) and return the activities path."""
+    """Flush the activity JSON (+ device trace) and return the activities path.
+
+    Spans still open at stop time (a crash mid-op, a user who never called
+    ``timeline_end_activity``) are closed here — emitted up to the stop
+    timestamp instead of silently dropped, the way the reference's writer
+    thread drains its queue on shutdown."""
     global _path_prefix, _profiler_active, _native_active
     if _profiler_active:
         try:
             jax.profiler.stop_trace()
         finally:
             _profiler_active = False
+    dangling_anns = []
     with _lock:
         if _path_prefix is None:
             return None
         out = _path_prefix + ".activities.json"
+        now = _now_us()
+        pid = os.getpid()
+        tid = threading.get_ident() % 1_000_000
+        for tensor_name, spans in _open_spans.items():
+            while spans:
+                activity, t0, ann = spans.pop()
+                dangling_anns.append(ann)
+                if _native_active:
+                    _native.timeline_record(
+                        activity, tensor_name, "X", int(t0),
+                        int(now - t0), pid, tid)
+                else:
+                    _events.append({
+                        "name": activity, "cat": tensor_name, "ph": "X",
+                        "ts": t0, "dur": now - t0, "pid": pid, "tid": tid,
+                    })
+        _open_spans.clear()
         if _native_active:
             _native.timeline_stop()
             _native_active = False
@@ -95,7 +118,12 @@ def stop_timeline() -> Optional[str]:
             with open(out, "w") as f:
                 json.dump({"traceEvents": _events, "displayTimeUnit": "ms"}, f)
         _path_prefix = None
-        return out
+    for ann in dangling_anns:
+        try:
+            ann.__exit__(None, None, None)
+        except Exception:       # the profiler may already be gone
+            pass
+    return out
 
 
 def _now_us() -> float:
@@ -135,6 +163,32 @@ def timeline_end_activity(tensor_name: str) -> bool:
                 "ts": t0, "dur": _now_us() - t0, "pid": pid, "tid": tid,
             })
     ann.__exit__(None, None, None)
+    return True
+
+
+def record_span(tensor_name: str, activity_name: str,
+                start_us: float, dur_us: float) -> bool:
+    """Record an already-completed span directly (no TraceAnnotation).
+
+    For writers on threads that do not own a start/end pair — the stall
+    watchdog records one span per warning interval this way.  Safe to call
+    from any thread; no-op when the timeline is off."""
+    if _path_prefix is None:
+        return False
+    pid = os.getpid()
+    tid = threading.get_ident() % 1_000_000
+    with _lock:
+        if _path_prefix is None:
+            return False
+        if _native_active:
+            _native.timeline_record(
+                activity_name, tensor_name, "X", int(start_us),
+                int(dur_us), pid, tid)
+        else:
+            _events.append({
+                "name": activity_name, "cat": tensor_name, "ph": "X",
+                "ts": start_us, "dur": dur_us, "pid": pid, "tid": tid,
+            })
     return True
 
 

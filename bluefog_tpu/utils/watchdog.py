@@ -18,6 +18,8 @@ from typing import Any, Optional
 
 import jax
 
+from . import metrics as _metrics
+from . import timeline as _tl
 from .config import logger
 
 DEFAULT_INTERVAL_S = 60.0   # reference: STALL_WARNING_TIME, operations.cc:47
@@ -33,6 +35,12 @@ def synchronize_with_watchdog(
     Logs a warning every ``interval`` seconds until the computation backing
     ``x`` completes; returns ``x``.  Zero overhead on the happy path beyond
     one timer thread that is cancelled on completion.
+
+    Each warning also lands in the telemetry layer: the
+    ``bluefog_watchdog_stalls_total`` counter increments, and when a
+    timeline is active the waited interval is recorded as a ``STALL``
+    activity span — so a stalled job is visible on the dashboard and in
+    the trace, not just in the log.
     """
     done = threading.Event()
     t0 = time.monotonic()
@@ -41,10 +49,17 @@ def synchronize_with_watchdog(
         n = 0
         while not done.wait(interval):
             n += 1
+            waited = time.monotonic() - t0
             logger.warning(
                 "%s has not completed after %.0f s — one or more devices/"
                 "hosts may be stalled (reference: stalled-tensor warning)",
-                name, time.monotonic() - t0)
+                name, waited)
+            _metrics.counter(
+                "bluefog_watchdog_stalls_total",
+                "watchdog stall-warning intervals elapsed").inc(name=name)
+            now_us = _tl._now_us()
+            _tl.record_span(name, "STALL",
+                            now_us - interval * 1e6, interval * 1e6)
 
     t = threading.Thread(target=watch, daemon=True)
     t.start()

@@ -87,6 +87,46 @@ def test_trace_analyze_fallback_busiest_track():
     assert doc["ok"] and doc["n_events"] == 4
 
 
+def test_comm_re_classification():
+    """Pin the comm-vs-compute classifier: every modern collective spelling
+    (ragged-all-to-all, fusion-wrapped async -start/-done forms, bare
+    send/recv) is comm; fusions, copies, and convolutions are compute —
+    copy-start/copy-done especially must NOT ride the '-start' suffix into
+    the comm bucket."""
+    ta = _load("trace_analyze")
+    comm = [
+        "ragged-all-to-all.1", "all-reduce-start.2", "all-reduce-done.2",
+        "loop_fusion.collective-permute-start.5", "AllToAll.9",
+        "all_gather.4", "reduce_scatter.1", "collective-broadcast.2",
+        "send.3", "recv-done.4", "ppermute",
+    ]
+    compute = [
+        "fusion.42", "copy-start.1", "copy-done.1",
+        "dynamic-update-slice.7", "convolution.2", "dot.11",
+    ]
+    for name in comm:
+        assert ta.COMM_RE.search(name), f"should be comm: {name}"
+    for name in compute:
+        assert not ta.COMM_RE.search(name), f"should be compute: {name}"
+
+
+def test_obs_trace_fixture_arithmetic():
+    """The committed obs-smoke fixture (Makefile `obs-smoke` runs the CLI
+    on the same file): compute [0,100)+[150,250), comm
+    [80,140)+[200,220), exposed [100,140), idle [140,150)."""
+    ta = _load("trace_analyze")
+    doc = json.load(open(
+        os.path.join(REPO, "tests", "fixtures", "obs_trace.trace.json")))
+    out = ta.analyze(doc["traceEvents"])
+    assert out["ok"] and out["n_events"] == 8
+    assert abs(out["wall_ms"] - 250.0) < 1e-6
+    assert abs(out["compute_ms"] - 200.0) < 1e-6
+    assert abs(out["comm_ms"] - 80.0) < 1e-6
+    assert abs(out["comm_exposed_ms"] - 40.0) < 1e-6
+    assert abs(out["overlap_fraction"] - 0.5) < 1e-3
+    assert abs(out["idle_ms"] - 10.0) < 1e-6
+
+
 def test_perf_fill_renders_and_is_idempotent(tmp_path, monkeypatch):
     measured = tmp_path / "measured"
     measured.mkdir()

@@ -43,6 +43,8 @@ MODULES = [
     ("bluefog_tpu.utils.tf_compat", "TensorFlow/Keras migration helpers"),
     ("bluefog_tpu.utils.config", "Environment configuration"),
     ("bluefog_tpu.utils.timeline", "Timeline tracing"),
+    ("bluefog_tpu.utils.metrics", "Live metrics registry + exporters"),
+    ("bluefog_tpu.diagnostics", "Consensus-health probes"),
     ("bluefog_tpu.utils.watchdog", "Stall watchdog"),
 ]
 

@@ -451,6 +451,17 @@ def run_battery(tag: str, stub: bool, no_commit: bool,
     summary_tag = f"{tag}x" if stage > 0 else tag
     summary = {"tag": summary_tag, "stage": stage, "utc": _utcnow(),
                "steps": results}
+    # surface the bench artifact's embedded telemetry block (step-time
+    # percentiles, comm bytes, cache hit ratio, consensus sample) at
+    # battery level, so the graded summary carries it directly
+    try:
+        with open(os.path.join(MEASURED,
+                               f"bench_{summary_tag}.json")) as f:
+            bench_doc = json.load(f)
+        if isinstance(bench_doc, dict) and bench_doc.get("metrics_summary"):
+            summary["metrics_summary"] = bench_doc["metrics_summary"]
+    except Exception:
+        pass
     with open(os.path.join(MEASURED, f"battery_{summary_tag}.json"),
               "w") as f:
         json.dump(summary, f, indent=1)

@@ -1,0 +1,197 @@
+"""Merge per-host BLUEFOG_METRICS JSONL logs into one job-level report.
+
+Each host of a multi-host job writes its own ``<prefix>.metrics.jsonl``
+(one registry snapshot per line, appended by ``bluefog_tpu.utils.metrics``
+— see ``sample()``).  This tool is the job-level view: give it every
+host's file and it merges the *last* snapshot per host —
+
+    counters     summed across hosts (per label set)
+    gauges       per-host values + max/mean (a gauge is a local fact;
+                 summing step-time EWMAs would be nonsense)
+    histograms   bucket-wise sum (same boundaries required — they come
+                 from one code version; mismatches are reported, not
+                 silently merged)
+
+— plus time series of the operator-facing gauges (step-time EWMA,
+consensus distance) across every sample of every host, so a dashboardless
+operator can still see the contraction trace.
+
+Run: python tools/metrics_report.py host0.metrics.jsonl host1.metrics.jsonl
+     [--out report.json]
+
+Output schema (stable, pinned by tests/test_metrics.py):
+    {"ok": bool, "n_hosts": int, "n_samples": int, "hosts": [int, ...],
+     "metrics": {name: {"type": ..., ...merged...}},
+     "series": {name: [[ts, host, value], ...]},
+     "summary": {...metrics-summary-shaped block...}}
+"""
+import argparse
+import json
+import os
+import sys
+
+# gauges worth a full time series in the report (everything else only
+# contributes its final value)
+SERIES_GAUGES = (
+    "bluefog_step_time_ewma_s",
+    "bluefog_consensus_distance_max",
+    "bluefog_consensus_distance_mean",
+    "bluefog_neighbor_disagreement_max",
+)
+
+
+def load_samples(path):
+    """All JSON lines of one host log (skips truncated trailing lines)."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue              # torn final line from a killed writer
+    return out
+
+
+def _merge_counter(acc, doc):
+    vals = acc.setdefault("values", {})
+    for k, v in doc.get("values", {}).items():
+        vals[k] = vals.get(k, 0.0) + v
+
+
+def _merge_histogram(acc, doc, notes):
+    if "buckets" not in acc:
+        acc.update(count=0, sum=0.0,
+                   buckets=[[b, 0] for b, _ in doc.get("buckets", [])])
+    if [b for b, _ in acc["buckets"]] != [b for b, _ in doc.get("buckets", [])]:
+        notes.append(f"bucket mismatch for a histogram; host skipped")
+        return
+    acc["count"] += doc.get("count", 0)
+    acc["sum"] += doc.get("sum", 0.0)
+    for slot, (_, c) in zip(acc["buckets"], doc["buckets"]):
+        slot[1] += c
+
+
+def _merge_gauge(acc, doc, host):
+    per_host = acc.setdefault("per_host", {})
+    for k, v in doc.get("values", {}).items():
+        per_host.setdefault(str(host), {})[k] = v
+
+
+def merge(host_samples):
+    """``{host: [samples...]}`` -> report dict."""
+    merged = {}
+    series = {}
+    notes = []
+    n_samples = 0
+    for host, samples in sorted(host_samples.items()):
+        n_samples += len(samples)
+        for s in samples:
+            for name in SERIES_GAUGES:
+                doc = s.get("metrics", {}).get(name)
+                if doc and doc.get("values"):
+                    v = doc["values"].get("")
+                    if v is not None:
+                        series.setdefault(name, []).append(
+                            [s.get("ts"), host, v])
+        if not samples:
+            notes.append(f"host {host}: empty log")
+            continue
+        last = samples[-1].get("metrics", {})
+        for name, doc in last.items():
+            kind = doc.get("type", "untyped")
+            acc = merged.setdefault(name, {"type": kind})
+            if acc["type"] != kind:
+                notes.append(f"{name}: type mismatch across hosts")
+                continue
+            if kind == "counter":
+                _merge_counter(acc, doc)
+            elif kind == "histogram":
+                _merge_histogram(acc, doc, notes)
+            else:
+                _merge_gauge(acc, doc, host)
+    for name, acc in merged.items():
+        if acc["type"] not in ("counter", "histogram"):
+            vals = [v for per_key in acc.get("per_host", {}).values()
+                    for v in per_key.values()]
+            if vals:
+                acc["max"] = max(vals)
+                acc["mean"] = sum(vals) / len(vals)
+    for name in series:
+        series[name].sort(key=lambda row: (row[0] is None, row[0]))
+    report = {
+        "ok": True,
+        "n_hosts": len(host_samples),
+        "n_samples": n_samples,
+        "hosts": sorted(host_samples),
+        "metrics": merged,
+        "series": series,
+        "summary": _summary(merged),
+    }
+    if notes:
+        report["notes"] = notes
+    return report
+
+
+def _summary(merged):
+    """Artifact-style summary from the merged metrics (the multi-host
+    counterpart of ``metrics.metrics_summary()``)."""
+    def ctot(name):
+        return sum(merged.get(name, {}).get("values", {}).values())
+
+    out = {}
+    h = merged.get("bluefog_step_time_s")
+    if h and h.get("count"):
+        out["step_time_s"] = {
+            "count": h["count"],
+            "mean": h["sum"] / h["count"],
+            "buckets": h["buckets"],
+        }
+    out["comm_bytes_total"] = ctot("bluefog_op_bytes_total")
+    hits = ctot("bluefog_compile_cache_hits_total")
+    misses = ctot("bluefog_compile_cache_misses_total")
+    out["cache"] = {"hits": hits, "misses": misses,
+                    "hit_ratio": hits / (hits + misses)
+                    if hits + misses else None}
+    g = merged.get("bluefog_consensus_distance_max")
+    if g and "max" in g:
+        out["consensus_distance_max"] = g["max"]
+    out["retrace_after_warmup"] = ctot("bluefog_retrace_after_warmup_total")
+    out["watchdog_stalls"] = ctot("bluefog_watchdog_stalls_total")
+    return out
+
+
+def report_from_files(paths):
+    host_samples = {}
+    for i, path in enumerate(paths):
+        samples = load_samples(path)
+        # the host id rides in each line; fall back to the file position so
+        # two single-host simulations on one machine still merge as two
+        host = samples[-1].get("host", i) if samples else i
+        if host in host_samples:
+            host = max(host_samples) + 1
+        host_samples[host] = samples
+    return merge(host_samples)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("logs", nargs="+", help="per-host *.metrics.jsonl files")
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+    try:
+        doc = report_from_files(args.logs)
+    except OSError as e:
+        doc = {"ok": False, "error": f"{type(e).__name__}: {e}"[:300]}
+    print(json.dumps(doc))
+    if args.out:
+        os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+        with open(args.out, "w") as f:
+            json.dump(doc, f, indent=1)
+    sys.exit(0 if doc.get("ok") else 1)
+
+
+if __name__ == "__main__":
+    main()

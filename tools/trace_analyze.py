@@ -32,9 +32,19 @@ import os
 import re
 import sys
 
+# Collective classifier.  Substring match over the comm-op token set,
+# tolerant of the spellings XLA traces actually contain: dashed HLO names
+# ("all-reduce.3"), underscore/camel-case metadata ("AllToAll"), ragged
+# variants ("ragged-all-to-all.1"), and async pairs — including
+# fusion-wrapped ones like "loop_fusion.collective-permute-start.5" —
+# whose -start/-done halves must both count as comm.  "copy-start"/"copy-
+# done" (async D2D copies) must NOT match: no comm token, no match.
 COMM_RE = re.compile(
-    r"all-reduce|all-gather|reduce-scatter|collective-permute|all-to-all"
-    r"|\bsend\b|\brecv\b|ppermute|collective", re.I)
+    r"ragged[-_]?all[-_]?to[-_]?all"
+    r"|all[-_]?reduce|all[-_]?gather|reduce[-_]?scatter"
+    r"|collective[-_]?permute|all[-_]?to[-_]?all|collective[-_]?broadcast"
+    r"|\bsend(?:[-_]done)?\b|\brecv(?:[-_]done)?\b"
+    r"|ppermute|collective", re.I)
 DEVICE_RE = re.compile(r"tpu|/device:|gpu", re.I)
 
 

@@ -6,7 +6,7 @@ PY ?= python
 
 .PHONY: test test-fast test_basic test_ops test_win_ops test_optimizer \
 	test_hier test_native test_examples verify native clean hw-watch \
-	obs-smoke
+	obs-smoke chaos-smoke
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -89,6 +89,12 @@ obs-smoke:
 		assert r['ok'] and r['n_hosts'] == 2 and all(k in r for k in \
 		('metrics', 'series', 'summary')), r; \
 		print('obs-smoke OK')"
+
+# resilience smoke: deterministic fault injection + healing/rollback on
+# the virtual CPU mesh (kill->heal->contract, NaN->rollback, restart
+# supervisor) — the fast chaos tier; heavy chaos runs are marked `slow`
+chaos-smoke:
+	$(PY) -m pytest tests/test_chaos.py tests/test_resilience.py -q
 
 # background TPU-tunnel watcher: probes every ~10 min, runs the full
 # measurement battery unattended on the first success (tools/hw_watch.py)

@@ -52,6 +52,9 @@ from .api import (
     barrier, synchronize, poll, hard_sync, resolve_schedule, shard_distributed,
 )
 from . import diagnostics
-from .diagnostics import diagnose_consensus, consensus_distance
+from .diagnostics import diagnose_consensus, consensus_distance, check_finite
+from . import resilience
+from .resilience import mark_rank_dead, dead_ranks, guard_step
+from .utils import chaos
 
 __version__ = "0.1.0"

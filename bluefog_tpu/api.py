@@ -27,6 +27,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from . import ops
 from .parallel import context as _mesh
 from .schedule import CommSchedule, compile_from_weights
+from .utils import chaos as _chaos
 from .utils import metrics as _metrics
 from .utils import timeline as _tl
 
@@ -45,7 +46,12 @@ def _dispatch(op_name, fn, *args):
     payload bytes in the metrics registry."""
     _metrics.record_op(op_name, args)
     with _tl.op_span(op_name):
-        return fn(*args)
+        out = fn(*args)
+    # fault injection (zero-cost gate: one attribute load when no plan is
+    # installed) — chaos may kill this rank, stall it, or NaN its payload
+    if _chaos._plan is not None:
+        out = _chaos.on_eager_op(op_name, out)
+    return out
 
 
 def _cached(key, build):

@@ -22,10 +22,31 @@ from typing import Any, Optional, Tuple
 
 import jax
 
-__all__ = ["save", "restore", "restore_latest", "latest_step",
-           "resize_distributed", "AsyncSaver"]
+__all__ = ["save", "restore", "restore_latest", "latest_step", "all_steps",
+           "is_complete", "resize_distributed", "AsyncSaver"]
 
 _STEP_DIR = re.compile(r"^step_(\d+)$")
+
+# Completion marker: written as the LAST act of a save, so a directory
+# missing it was interrupted mid-write (killed rank, preempted host) and
+# must not be restored from.  Orbax's own GCS-style commit file is honored
+# too, so checkpoints written by other tooling still count as complete.
+_COMPLETE_MARKER = ".bluefog_complete"
+_ORBAX_COMMIT = "commit_success.txt"
+
+
+def _mark_complete(path: str) -> None:
+    """Stamp a finished checkpoint (process 0 only: shared directory)."""
+    if jax.process_index() != 0:
+        return
+    with open(os.path.join(path, _COMPLETE_MARKER), "w") as f:
+        f.write("complete\n")
+
+
+def is_complete(path: str) -> bool:
+    """True iff ``path`` is a fully-written checkpoint directory."""
+    return (os.path.exists(os.path.join(path, _COMPLETE_MARKER))
+            or os.path.exists(os.path.join(path, _ORBAX_COMMIT)))
 
 
 def _checkpointer():
@@ -47,8 +68,12 @@ def save(directory: str, state: Any, step: int, *, keep: Optional[int] = None) -
     # block so the snapshot is consistent even mid-training-loop
     state = jax.block_until_ready(state)
     _checkpointer().save(path, state, force=True)
+    _mark_complete(path)
     # Prune from one process only: in multi-process runs the directory is
     # shared, and concurrent rmtree races against other processes' saves.
+    # Only *complete* checkpoints are counted against ``keep`` — and only
+    # complete ones are deleted: an unmarked directory might be another
+    # process's save still in flight.
     if keep is not None and jax.process_index() == 0:
         steps = sorted(all_steps(directory))
         for s in steps[:-keep]:
@@ -84,18 +109,26 @@ def restore(path: str, template: Optional[Any] = None) -> Any:
         return ckpt.restore(path)
 
 
-def all_steps(directory: str):
+def all_steps(directory: str, include_incomplete: bool = False):
+    """Sorted step numbers of the checkpoints in ``directory``.
+
+    Partially-written ``step_*`` directories (no completion marker — e.g. a
+    save interrupted by a killed rank) are skipped unless
+    ``include_incomplete=True``.
+    """
     if not os.path.isdir(directory):
         return []
     out = []
     for name in os.listdir(directory):
         m = _STEP_DIR.match(name)
-        if m:
+        if m and (include_incomplete
+                  or is_complete(os.path.join(directory, name))):
             out.append(int(m.group(1)))
     return sorted(out)
 
 
 def latest_step(directory: str) -> Optional[int]:
+    """Newest *complete* checkpoint step (None when there is none)."""
     steps = all_steps(directory)
     return steps[-1] if steps else None
 
@@ -103,7 +136,13 @@ def latest_step(directory: str) -> Optional[int]:
 def restore_latest(
     directory: str, template: Optional[Any] = None,
 ) -> Tuple[Optional[Any], Optional[int]]:
-    """Load the newest checkpoint in ``directory``; ``(None, None)`` if empty."""
+    """Load the newest *complete* checkpoint in ``directory``.
+
+    Falls past any partially-written ``step_*`` directory to the newest
+    checkpoint that finished its write — the elastic-restart contract: a
+    respawned rank must never resume from the save its predecessor died
+    in the middle of.  ``(None, None)`` when nothing complete exists.
+    """
     step = latest_step(directory)
     if step is None:
         return None, None
@@ -131,18 +170,50 @@ class AsyncSaver:
     def __init__(self):
         import orbax.checkpoint as ocp
         self._ckpt = ocp.AsyncCheckpointer(ocp.PyTreeCheckpointHandler())
+        self._pending: list = []      # paths saved but not yet marked complete
+
+    def _check_for_errors(self) -> None:
+        """Surface a background-thread save failure on the caller's thread.
+
+        An async write that died (disk full, permissions, serialization
+        bug) would otherwise fail *silently* until the job tried to restore
+        from a half-written directory.  Raising at the next ``save()`` /
+        ``wait()`` turns it into an actionable error at a known step.
+        """
+        check = getattr(self._ckpt, "check_for_errors", None)
+        if check is not None:
+            check()
+
+    def _finalize_pending(self) -> None:
+        """Stamp completion markers for saves known to have finished.
+
+        Called only after ``wait_until_finished`` + error check: a marker
+        must never land on a directory whose background write failed."""
+        for path in self._pending:
+            if os.path.isdir(path):
+                _mark_complete(path)
+        self._pending.clear()
 
     def save(self, directory: str, state: Any, step: int) -> str:
         directory = os.path.abspath(directory)
         os.makedirs(directory, exist_ok=True)
         path = os.path.join(directory, f"step_{int(step)}")
         state = jax.block_until_ready(state)
+        # serialize with the previous save, surface its errors HERE, and
+        # only then mark it complete — readers never see a premature marker
+        self._check_for_errors()
+        self._ckpt.wait_until_finished()
+        self._finalize_pending()
         self._ckpt.save(path, state, force=True)
+        self._pending.append(path)
         return path
 
     def wait(self) -> None:
-        """Block until every in-flight save is durably on disk."""
+        """Block until every in-flight save is durably on disk (raising if
+        a background save failed), then mark it complete."""
         self._ckpt.wait_until_finished()
+        self._check_for_errors()
+        self._finalize_pending()
 
     def close(self) -> None:
         self.wait()

@@ -29,7 +29,7 @@ every step.
 """
 from __future__ import annotations
 
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -42,7 +42,9 @@ from .parallel import context as _mesh
 from .schedule import CommSchedule
 from .utils import metrics as _metrics
 
-__all__ = ["diagnose_consensus", "consensus_distance", "window_staleness"]
+__all__ = ["diagnose_consensus", "consensus_distance", "window_staleness",
+           "check_finite", "record_peer_failure", "observe_peer_finiteness",
+           "peer_health", "unhealthy_ranks", "reset_peer_health"]
 
 
 def _float_mask(tree) -> tuple:
@@ -64,25 +66,44 @@ def _flat_f32(tree) -> jax.Array:
     return jnp.concatenate(leaves) if leaves else jnp.zeros((1,), jnp.float32)
 
 
-def _probe_program(ctx, sched: Optional[CommSchedule], sig):
-    """Compiled probe: distributed params -> (distance [n], disagreement [n])."""
-    in_deg = (np.asarray([len(s) for s in sched.in_neighbors], np.int32)
-              if sched is not None else None)
+def _probe_program(ctx, sched: Optional[CommSchedule], sig,
+                   dead: tuple = ()):
+    """Compiled probe: distributed params -> (distance [n], disagreement [n]).
+
+    ``dead`` restricts the network average (and the disagreement mask) to
+    the surviving ranks: the resilience layer's view of consensus after a
+    rank death — dead ranks report 0 and contribute nothing to the mean.
+    """
+    n = ctx.size
+    alive = np.ones(n, np.float32)
+    alive[list(dead)] = 0.0
+    n_alive = float(alive.sum())
+    if sched is not None:
+        in_deg = np.asarray([len(s) for s in sched.in_neighbors], np.int32)
+        slots = max(sched.max_in_degree, 1)
+        # [n, slots] slot mask: slot k of rank d counts iff it is a real
+        # (not zero-filled) mailbox AND its source rank is alive
+        slot_alive = np.zeros((n, slots), np.float32)
+        for d in range(n):
+            for k, src in enumerate(sched.in_neighbors[d]):
+                slot_alive[d, k] = alive[src]
 
     def per_rank(tree):
         v = _flat_f32(jax.tree.map(lambda x: x[0], tree))
-        vbar = lax.pmean(v, "rank")
-        dist = jnp.sqrt(jnp.sum((v - vbar) ** 2))
+        me = lax.axis_index("rank")
+        me_alive = jnp.asarray(alive)[me]
+        vbar = lax.psum(v * me_alive, "rank") / n_alive
+        dist = jnp.sqrt(jnp.sum((v - vbar) ** 2)) * me_alive
         if sched is not None and sched.max_in_degree > 0:
-            slots = max(sched.max_in_degree, 1)
             g = ops.neighbor_allgather(v, sched, axis="rank")
             g = g.reshape(slots, v.shape[0])
             diffs = jnp.sqrt(jnp.sum((g - v[None, :]) ** 2, axis=1))
             # trailing slots on low-degree ranks are zero-filled, not
-            # neighbor values — mask by this rank's static in-degree
-            mydeg = jnp.asarray(in_deg)[lax.axis_index("rank")]
-            disagree = jnp.max(
-                jnp.where(jnp.arange(slots) < mydeg, diffs, 0.0))
+            # neighbor values — mask by static in-degree and liveness
+            mask = jnp.asarray(slot_alive)[me]
+            disagree = jnp.max(jnp.where(
+                (jnp.arange(slots) < jnp.asarray(in_deg)[me]) & (mask > 0),
+                diffs, 0.0)) * me_alive
         else:
             disagree = jnp.zeros((), jnp.float32)
         return dist[None], disagree[None]
@@ -93,7 +114,7 @@ def _probe_program(ctx, sched: Optional[CommSchedule], sig):
             out_specs=(P("rank"), P("rank"))))
 
     return _mesh.cached_program(
-        ("diag-consensus", sched, ctx.mesh, sig), build)
+        ("diag-consensus", sched, ctx.mesh, sig, dead), build)
 
 
 def consensus_distance(params: Any,
@@ -116,14 +137,17 @@ def window_staleness() -> Dict[str, int]:
 
 def diagnose_consensus(params: Any, *,
                        schedule: Optional[CommSchedule] = None,
+                       dead_ranks: Sequence[int] = (),
                        record: bool = True) -> Dict[str, Any]:
     """One health sample over distributed ``params``.
 
     Returns consensus distance (per-rank array + max/mean), max neighbor
     disagreement under ``schedule`` (default: the context's static
     schedule; skipped when no topology is set), and window staleness.
-    ``record=True`` also publishes the scalars as registry gauges so the
-    exporters pick them up.
+    ``dead_ranks`` restricts the probe to the survivors after a rank death
+    (the resilience layer's view: the network average excludes dead ranks,
+    which report distance 0).  ``record=True`` also publishes the scalars
+    as registry gauges so the exporters pick them up.
     """
     ctx = _mesh.get_context()
     if schedule is None:
@@ -131,15 +155,19 @@ def diagnose_consensus(params: Any, *,
             schedule = ctx.static_schedule()
         except RuntimeError:
             schedule = None
-    fn = _probe_program(ctx, schedule, _float_mask(params))
+    dead = tuple(sorted(set(int(r) for r in dead_ranks)))
+    if dead and len(dead) >= ctx.size:
+        raise ValueError(f"all {ctx.size} ranks marked dead")
+    fn = _probe_program(ctx, schedule, _float_mask(params), dead)
     dist, disagree = fn(params)
     dist = np.asarray(dist)
     disagree = np.asarray(disagree)
+    alive = [r for r in range(ctx.size) if r not in dead]
     staleness = window_staleness()
     out = {
         "consensus_distance": dist,
         "consensus_distance_max": float(dist.max()),
-        "consensus_distance_mean": float(dist.mean()),
+        "consensus_distance_mean": float(dist[alive].mean()),
         "neighbor_disagreement": disagree,
         "neighbor_disagreement_max": float(disagree.max()),
         "window_staleness": staleness,
@@ -159,3 +187,97 @@ def diagnose_consensus(params: Any, *,
                            "max unconsumed mailbox deliveries"
                            ).set(max(staleness.values()))
     return out
+
+
+# ---------------------------------------------------------------------------
+# Non-finite guard + peer-health tracking (the detection half of the
+# resilience story: bluefog_tpu/resilience.py owns the response)
+# ---------------------------------------------------------------------------
+
+def check_finite(tree: Any) -> np.ndarray:
+    """Per-rank all-finite flag over the float leaves of a distributed tree.
+
+    Returns a ``[n]`` bool array: ``out[r]`` is False iff any float element
+    of rank r's shard is NaN/Inf.  Compiled once per tree signature through
+    the shared program cache — at a sampling cadence (the guard wrappers
+    check every k-th call, same pattern as ``metrics_every_k``) this adds
+    zero steady-state compilations, and because it reads a step's *outputs*
+    it composes with donation.
+    """
+    ctx = _mesh.get_context()
+
+    def per_rank(t):
+        v = _flat_f32(jax.tree.map(lambda x: x[0], t))
+        return jnp.isfinite(v).all()[None]
+
+    def build():
+        return jax.jit(jax.shard_map(
+            per_rank, mesh=ctx.mesh, in_specs=P("rank"),
+            out_specs=P("rank")))
+
+    fn = _mesh.cached_program(
+        ("diag-finite", ctx.mesh, _float_mask(tree)), build)
+    return np.asarray(fn(tree))
+
+
+# Host-side peer-health table: which ranks have produced non-finite output
+# (and how persistently), plus explicitly reported failures (a RankKilled
+# caught by the training loop, a watchdog timeout attributed to a peer).
+# The SPMD analogue of the reference's stalled-rank bookkeeping
+# (CheckForStalledTensors tracks *which* ranks' requests are missing).
+_peer_lock = __import__("threading").Lock()
+_peer_nonfinite_streak: Dict[int, int] = {}
+_peer_last_bad_step: Dict[int, int] = {}
+_peer_failed: set = set()
+
+
+def observe_peer_finiteness(finite: np.ndarray,
+                            step: Optional[int] = None) -> None:
+    """Feed one :func:`check_finite` sample into the peer-health table."""
+    with _peer_lock:
+        for r, ok in enumerate(np.asarray(finite)):
+            if bool(ok):
+                _peer_nonfinite_streak[r] = 0
+            else:
+                _peer_nonfinite_streak[r] = _peer_nonfinite_streak.get(r, 0) + 1
+                if step is not None:
+                    _peer_last_bad_step[r] = int(step)
+        bad = sum(1 for v in _peer_nonfinite_streak.values() if v > 0)
+    _metrics.gauge("bluefog_peers_nonfinite",
+                   "ranks whose latest sampled output was non-finite"
+                   ).set(bad)
+
+
+def record_peer_failure(rank: int) -> None:
+    """Mark a rank as failed (killed, restarted, or timed out)."""
+    with _peer_lock:
+        _peer_failed.add(int(rank))
+    _metrics.gauge("bluefog_peers_failed",
+                   "ranks explicitly reported failed").set(len(_peer_failed))
+
+
+def unhealthy_ranks(streak: int = 1) -> Tuple[int, ...]:
+    """Ranks currently considered unhealthy: explicitly failed, or with at
+    least ``streak`` consecutive non-finite samples."""
+    with _peer_lock:
+        bad = set(_peer_failed)
+        bad.update(r for r, v in _peer_nonfinite_streak.items()
+                   if v >= streak)
+    return tuple(sorted(bad))
+
+
+def peer_health() -> Dict[str, Any]:
+    """Snapshot of the peer-health table (for dashboards and tests)."""
+    with _peer_lock:
+        return {
+            "failed": tuple(sorted(_peer_failed)),
+            "nonfinite_streak": dict(_peer_nonfinite_streak),
+            "last_bad_step": dict(_peer_last_bad_step),
+        }
+
+
+def reset_peer_health() -> None:
+    with _peer_lock:
+        _peer_failed.clear()
+        _peer_nonfinite_streak.clear()
+        _peer_last_bad_step.clear()

@@ -41,6 +41,21 @@ def _table(row: np.ndarray, idx: jax.Array, dtype=None) -> jax.Array:
     return t.astype(dtype) if dtype is not None else t
 
 
+def corrupt_payload(x: jax.Array, rank: int, *, axis: Axis = "rank") -> jax.Array:
+    """Fault-injection support: NaN this block iff the device IS ``rank``.
+
+    The traced primitive behind :mod:`bluefog_tpu.utils.chaos`'s payload
+    corruption — the sick-rank emulation whose detection/rollback the
+    resilience layer owes the user.  Non-target ranks pass their block
+    through untouched; integer payloads are left alone (NaN has no integer
+    encoding, and corrupting lengths/counters would break shape plumbing
+    rather than emulate a numerics fault)."""
+    if not jnp.issubdtype(x.dtype, jnp.inexact):
+        return x
+    bad = jnp.full(x.shape, jnp.nan, x.dtype)
+    return jnp.where(lax.axis_index(axis) == rank, bad, x)
+
+
 WIRE_CODECS = ("bf16", "int8", "fp8")
 
 

@@ -48,6 +48,7 @@ from . import fusion, ops
 from .ops import windows as wops
 from .parallel import context as _mesh
 from .schedule import CommSchedule
+from .utils import chaos as _chaos
 from .utils import metrics as _metrics
 from .utils.timeline import named_span
 
@@ -1254,9 +1255,18 @@ class _InstrumentedStep:
     def __call__(self, *args, **kwargs):
         import time as _time
         t0 = _time.perf_counter()
+        # fault injection (zero-cost gate when no plan is installed): a
+        # kill/hang/throttle fault fires BEFORE dispatch — the sleep lands
+        # in the step-time metrics, which is how a straggler looks for real
+        if _chaos._plan is not None:
+            _chaos.on_train_step(self._calls + 1)
         out = self._fn(*args, **kwargs)
         dt = _time.perf_counter() - t0
         self._calls += 1
+        # payload corruption touches only the step OUTPUTS (donation-safe,
+        # same contract as the consensus probe below)
+        if _chaos._plan is not None:
+            out = _chaos.corrupt_train_output(out, self._calls)
         _metrics.record_step(dt, steps=self._steps_per_call,
                              donated=self._donated,
                              fused_k=self._steps_per_call)

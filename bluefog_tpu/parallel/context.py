@@ -211,6 +211,8 @@ def init(
         nodes_per_machine = jax.local_device_count() if jax.process_count() > 1 else n
     maybe_start_from_env()
     _metrics.maybe_start_from_env()
+    from ..utils import chaos as _chaos
+    _chaos.maybe_install_from_env()
     if n % nodes_per_machine != 0:
         raise ValueError(
             f"device count {n} not divisible by nodes_per_machine {nodes_per_machine}")
@@ -266,9 +268,12 @@ def shutdown() -> None:
     global _context
     from ..utils.timeline import stop_timeline
     from ..utils import metrics as _metrics
+    from ..utils import chaos as _chaos
     stop_timeline()
     _metrics.stop_metrics()   # final JSONL sample + close
     _metrics.mark_steady_state(False)
+    _chaos.uninstall()
+    _chaos._corrupt_programs.clear()  # jitted corruptors pin device buffers
     clear_program_cache()     # executables pin device buffers past shutdown
     with _lock:
         _context = None

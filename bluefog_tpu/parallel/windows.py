@@ -30,6 +30,7 @@ from jax.sharding import PartitionSpec as P
 
 from ..ops import windows as wops
 from ..schedule import CommSchedule, compile_from_weights
+from ..utils import chaos as _chaos
 from ..utils import metrics as _metrics
 from . import context as _mesh
 
@@ -243,6 +244,10 @@ def _move(kind: str, tensor_or_none, name: str, dst_weights,
                     jax.tree.map(lambda v: v[0], w), x[0], sched, axis="rank")),
                 ctx.mesh, (_win_specs(), P("rank")), _win_specs()))
         _assoc_p[name] = pfn(pwin, pwin.value)
+    # fault injection on the async-gossip path: same zero-cost gate as the
+    # eager op API — chaos may stall this op or NaN the window payload
+    if _chaos._plan is not None:
+        entry.window = _chaos.on_eager_op("win_" + kind, entry.window)
     entry.version += _delivered_mask(sched, slots)
 
 

@@ -1,0 +1,312 @@
+"""Topology healing + skip-and-rollback: keep training when a rank dies.
+
+The reference runtime has no answer to a dead peer: a rank that stops
+responding wedges every neighbor collective that names it (the timeline
+just shows the survivors parked in ``MPI_NEIGHBOR_ALLREDUCE`` forever),
+and a NaN-ed tensor propagates through the mixing matrix to every rank
+within a graph diameter of steps.  Elastic-Horovod-style recovery — drop
+the dead worker, rebuild the communicator, continue — is the behavior this
+module ports to the compiled-schedule world:
+
+* **Healing** (:func:`heal_schedule` / :func:`heal_topology` /
+  :func:`mark_rank_dead`): rebuild the weight tables with the dead ranks
+  excluded.  Every edge out of a dead rank is removed and its mixing mass
+  is folded into the *receiver's* self weight, so each surviving column of
+  W still sums to 1 — the healed matrix remains column-stochastic and the
+  survivors keep contracting toward *their* average.  Dead ranks become
+  isolated self-loops (weight 1): their devices still participate in the
+  SPMD program (the mesh cannot shrink mid-run) but neither send nor
+  receive mass.
+* **Recovery** (:func:`guard_step` / :class:`GuardedStep`): wrap the train
+  step with a sampled non-finite guard over its *outputs* (donation-safe,
+  compiled once through the shared program cache) and a host-side
+  ring buffer of last-known-good snapshots; a non-finite step is skipped
+  and the previous good state restored instead of poisoning the gossip.
+
+Healing recompiles schedules by design — callers see
+``mark_steady_state(False)`` so the retrace sentinel treats the heal as a
+new warmup, not a silent performance bug.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+import networkx as nx
+
+from . import diagnostics as _diag
+from . import topology as topo_util
+from .parallel import context as _mesh
+from .schedule import CommSchedule, compile_from_weights
+from .utils import metrics as _metrics
+
+__all__ = [
+    "heal_topology", "heal_schedule", "heal_dynamic_schedules",
+    "schedule_weight_matrix", "mark_rank_dead", "dead_ranks", "reset",
+    "GuardedStep", "guard_step",
+]
+
+
+def _normalize_dead(dead: Iterable[int], size: int) -> Tuple[int, ...]:
+    out = tuple(sorted(set(int(r) for r in dead)))
+    for r in out:
+        if not (0 <= r < size):
+            raise ValueError(f"dead rank {r} out of range for size {size}")
+    if len(out) >= size:
+        raise ValueError(f"cannot mark all {size} ranks dead")
+    return out
+
+
+def schedule_weight_matrix(sched: CommSchedule) -> np.ndarray:
+    """Dense ``W[src, dst]`` equivalent of a compiled schedule's tables."""
+    n = sched.size
+    W = np.zeros((n, n), dtype=np.float64)
+    for dst in range(n):
+        W[dst, dst] = float(sched.self_weight[dst])
+        for slot, src in enumerate(sched.in_neighbors[dst]):
+            W[src, dst] = float(sched.slot_weight[slot, dst])
+    return W
+
+
+def heal_topology(topo: nx.DiGraph, dead: Iterable[int]) -> nx.DiGraph:
+    """Healed copy of a *weighted* topology with ``dead`` ranks excluded.
+
+    For each surviving destination the mass of its dead in-edges moves into
+    its self-loop (column sums are preserved); dead ranks keep only a
+    unit self-loop.  Note this operates on the graph's mixing weights — for
+    a topology used unweighted (uniform ``1/(in_degree+1)`` averaging),
+    heal the compiled schedule instead (:func:`heal_schedule`), which sees
+    the weights actually in effect.
+    """
+    W = topo_util.to_weight_matrix(topo).astype(np.float64)
+    n = W.shape[0]
+    dead = _normalize_dead(dead, n)
+    for dst in range(n):
+        if dst in dead:
+            continue
+        W[dst, dst] += sum(W[d, dst] for d in dead)
+    for d in dead:
+        W[d, :] = 0.0
+        W[:, d] = 0.0
+        W[d, d] = 1.0
+    return topo_util._graph_from_matrix(W)
+
+
+def heal_schedule(sched: CommSchedule, dead: Iterable[int]) -> CommSchedule:
+    """Recompile a schedule with ``dead`` ranks carved out.
+
+    Reconstructs the per-rank ``{src: weight}`` tables from the schedule's
+    slot layout, drops every edge touching a dead rank (folding dead-source
+    mass into the receiver's self weight), and runs the result back through
+    :func:`bluefog_tpu.schedule.compile_from_weights`.  Any dst-weighting
+    (send scales) is intentionally dropped: push-sum style mass splitting
+    is not meaningful once the recipient set changed.
+    """
+    n = sched.size
+    dead = _normalize_dead(dead, n)
+    dead_set = set(dead)
+    self_w: List[float] = [float(w) for w in sched.self_weight]
+    src_w: List[Dict[int, float]] = []
+    for dst in range(n):
+        table: Dict[int, float] = {}
+        if dst in dead_set:
+            src_w.append(table)
+            self_w[dst] = 1.0
+            continue
+        for slot, src in enumerate(sched.in_neighbors[dst]):
+            w = float(sched.slot_weight[slot, dst])
+            if src in dead_set:
+                self_w[dst] += w      # fold dead mass into the self-loop
+            else:
+                table[src] = w
+        src_w.append(table)
+    return compile_from_weights(n, self_w, src_w)
+
+
+def heal_dynamic_schedules(schedules: Sequence[CommSchedule],
+                           dead: Iterable[int]) -> List[CommSchedule]:
+    """Heal every schedule of a dynamic (periodic) topology."""
+    dead = tuple(dead)
+    return [heal_schedule(s, dead) for s in schedules]
+
+
+# ---------------------------------------------------------------------------
+# Process-level dead-rank registry: the healing entry point the training
+# loop calls when it catches a RankKilled / watchdog timeout / persistent
+# non-finite peer.
+# ---------------------------------------------------------------------------
+
+_lock = threading.Lock()
+_dead: set = set()
+
+
+def dead_ranks() -> Tuple[int, ...]:
+    with _lock:
+        return tuple(sorted(_dead))
+
+
+def mark_rank_dead(*ranks: int) -> Tuple[int, ...]:
+    """Declare ranks dead and heal the live context around them.
+
+    Recompiles the context's static schedule (and any dynamic schedule
+    list) with the dead ranks excluded, updates the context topology to the
+    healed graph, feeds the peer-health table, and resets the steady-state
+    flag — the recompile that follows is an intended heal, not a retrace
+    regression.  Returns the full set of dead ranks.  Idempotent.
+    """
+    ctx = _mesh.get_context()
+    with _lock:
+        new = set(int(r) for r in ranks) - _dead
+        merged = _normalize_dead(_dead | new, ctx.size)
+        if not new:
+            return merged
+        _dead.update(new)
+    for r in sorted(new):
+        _diag.record_peer_failure(r)
+
+    if ctx.topology is not None:
+        healed = heal_schedule(ctx.static_schedule(), merged)
+        # graph view kept consistent with the healed tables so
+        # in_neighbor_ranks()/load_topology() reflect the surgery
+        ctx.topology = topo_util._graph_from_matrix(
+            schedule_weight_matrix(healed))
+        ctx.topology_weighted = True
+        ctx._sched = healed
+    if ctx.dynamic_schedules:
+        ctx.dynamic_schedules = heal_dynamic_schedules(
+            ctx.dynamic_schedules, merged)
+
+    # healing legitimately recompiles: new schedule => new program-cache
+    # keys.  Restart warmup so the retrace sentinel stays meaningful.
+    _metrics.mark_steady_state(False)
+    _metrics.gauge("bluefog_dead_ranks",
+                   "ranks currently marked dead and healed around"
+                   ).set(len(merged))
+    try:
+        from .utils import timeline as _tl
+        now = _tl._now_us()
+        _tl.record_span(f"resilience:heal:{','.join(map(str, sorted(new)))}",
+                        "FAULT", now, 1.0)
+    except Exception:                                     # pragma: no cover
+        pass
+    return merged
+
+
+def reset() -> None:
+    """Forget all dead ranks (does not un-heal an already-healed context;
+    call ``set_topology`` to reinstall a full topology)."""
+    with _lock:
+        _dead.clear()
+    _metrics.gauge("bluefog_dead_ranks",
+                   "ranks currently marked dead and healed around").set(0)
+
+
+# ---------------------------------------------------------------------------
+# Skip-and-rollback guard
+# ---------------------------------------------------------------------------
+
+class GuardedStep:
+    """Wrap a train step with a non-finite guard and a last-good ring buffer.
+
+    Every ``check_every_k``-th call the step's *outputs* are run through the
+    compiled :func:`bluefog_tpu.diagnostics.check_finite` probe (per-rank
+    all-finite flags).  Finite outputs are snapshotted to host memory
+    (``depth`` most recent); a non-finite step is *skipped*: the guard
+    restores the newest good snapshot — re-uploaded with each leaf's
+    original sharding, so the next step call hits the same compiled
+    program — and returns it in place of the poisoned outputs.
+
+    Donation-safe by construction: only outputs are inspected and
+    snapshots live on the host, so no reference to a donated input buffer
+    is ever retained.  Ranks in :func:`dead_ranks` are excluded from the
+    verdict (a healed-around rank's stale shard may be anything).
+    """
+
+    def __init__(self, fn: Callable, *, check_every_k: int = 1,
+                 depth: int = 2):
+        if depth < 1:
+            raise ValueError("depth must be >= 1")
+        self._fn = fn
+        self._k = max(1, int(check_every_k))
+        self._depth = int(depth)
+        self._ring: List[tuple] = []     # (treedef, [(np_leaf, sharding)])
+        self.calls = 0
+        self.nonfinite_steps = 0
+        self.rollbacks = 0
+
+    # -- snapshots --------------------------------------------------------
+    def _snapshot(self, out) -> None:
+        import jax
+        leaves, treedef = jax.tree.flatten(out)
+        host = [(np.asarray(jax.device_get(leaf)), leaf.sharding)
+                for leaf in leaves]
+        self._ring.append((treedef, host))
+        if len(self._ring) > self._depth:
+            self._ring.pop(0)
+
+    def _restore(self):
+        import jax
+        if not self._ring:
+            return None
+        treedef, host = self._ring[-1]
+        leaves = [jax.device_put(arr, sharding) for arr, sharding in host]
+        return jax.tree.unflatten(treedef, leaves)
+
+    def last_good(self):
+        """The newest good snapshot re-materialized on device (or None)."""
+        return self._restore()
+
+    # -- the step ---------------------------------------------------------
+    def __call__(self, *args, **kwargs):
+        from .utils.chaos import RankKilled
+        try:
+            out = self._fn(*args, **kwargs)
+        except RankKilled as e:
+            if e.rank is not None:
+                _diag.record_peer_failure(e.rank)
+            raise
+        self.calls += 1
+        if self.calls % self._k:
+            return out
+        finite = np.asarray(_diag.check_finite(out))
+        _diag.observe_peer_finiteness(finite, step=self.calls)
+        alive = np.ones(finite.shape[0], dtype=bool)
+        dead = [r for r in dead_ranks() if r < finite.shape[0]]
+        alive[dead] = False
+        if bool(finite[alive].all()):
+            self._snapshot(out)
+            return out
+        # non-finite on a live rank: skip this step, restore last good
+        self.nonfinite_steps += 1
+        bad = [int(r) for r in np.nonzero(~finite & alive)[0]]
+        _metrics.counter(
+            "bluefog_nonfinite_steps_total",
+            "train steps whose outputs failed the finite guard").inc()
+        try:
+            from .utils import timeline as _tl
+            _tl.record_span(
+                f"resilience:nonfinite:ranks={','.join(map(str, bad))}",
+                "FAULT", _tl._now_us(), 1.0)
+        except Exception:                                 # pragma: no cover
+            pass
+        restored = self._restore()
+        if restored is None:
+            raise FloatingPointError(
+                f"non-finite step outputs on ranks {bad} at call "
+                f"{self.calls} with no good snapshot to roll back to "
+                "(guard installed after the blow-up?)")
+        self.rollbacks += 1
+        return restored
+
+
+def guard_step(fn: Callable, *, check_every_k: int = 1,
+               depth: int = 2) -> GuardedStep:
+    """Convenience wrapper: ``guard_step(step_fn)(params, opt, batch)``.
+
+    Composes with the optimizer factories' instrumented steps — guard the
+    *outermost* callable so rollback sees exactly what the training loop
+    sees.  ``check_every_k`` amortizes the probe the same way
+    ``metrics_every_k`` does (the probe compiles once, during warmup).
+    """
+    return GuardedStep(fn, check_every_k=check_every_k, depth=depth)

@@ -99,6 +99,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "(sets BLUEFOG_METRICS_PORT; endpoint: /metrics)")
     p.add_argument("-x", "--env", action="append", default=[],
                    help="extra NAME=VALUE env for the child (repeatable)")
+    p.add_argument("--restart-limit", type=int, default=0,
+                   help="elastic restart: respawn a rank that exits "
+                        "non-zero up to N times (per rank) instead of "
+                        "tearing the job down; the respawned rank should "
+                        "resume from its latest complete checkpoint "
+                        "(checkpoint.restore_latest).  Default 0 = first "
+                        "failure kills the job (mpirun semantics)")
+    p.add_argument("--restart-backoff", type=float, default=1.0,
+                   help="base seconds for the exponential restart backoff "
+                        "(doubled per attempt, with deterministic jitter)")
     p.add_argument("--no-xla-tuning", action="store_true",
                    help="do not add the recommended TPU overlap XLA flags")
     p.add_argument("--interactive", action="store_true",
@@ -281,28 +291,105 @@ def _multihost_fanout(args, env) -> int:
         if args.verbose:
             print(f"bfrun-tpu:   {shlex.join(argv)}", flush=True)
         procs.append(subprocess.Popen(argv))
-    # first failure kills the survivors (mpirun semantics): a dead rank
-    # leaves the others blocked in jax.distributed collectives forever
+    # restart respawns the same remote argv: the rank's bootstrap env is
+    # baked into it, and resume-from-checkpoint is the child's job
+    return _supervise_procs(
+        procs,
+        respawn=lambda rank, _count: subprocess.Popen(plans[rank][2]),
+        restart_limit=args.restart_limit,
+        restart_backoff=args.restart_backoff,
+        labels=[f"rank {pid} on {host}" for host, pid, _ in plans])
+
+
+def _count_restart() -> None:
+    from ..utils import metrics as _metrics
+    _metrics.counter(
+        "bluefog_rank_restarts_total",
+        "rank respawns performed by the launcher supervisor").inc()
+
+
+def _supervise_procs(procs, respawn=None, *, restart_limit=0,
+                     restart_backoff=1.0, labels=None,
+                     poll_interval=0.2) -> int:
+    """Supervise one Popen per rank; the shared exit path for ``-np`` and
+    ``-H`` launches.
+
+    Default (``restart_limit=0``) keeps mpirun semantics — the first
+    non-zero exit terminates the survivors (a dead rank leaves the others
+    blocked in jax.distributed collectives forever) — but now *says which
+    rank died with which code* before doing so, and names it again in the
+    final error line: the reference's mpirun teardown loses exactly this
+    diagnosis.
+
+    With ``restart_limit=N`` (elastic restart, the Elastic-Horovod move):
+    a rank exiting non-zero is respawned via ``respawn(rank, attempt)`` up
+    to N times, after an exponential backoff with deterministic seeded
+    jitter (``restart_backoff * 2**(attempt-1)``, +0..25 %) so crash loops
+    do not hammer the host and two supervisors never thunder in lockstep.
+    Survivors keep running throughout; the respawned child is expected to
+    resume from its latest *complete* checkpoint.  Every respawn
+    increments ``bluefog_rank_restarts_total``.
+    """
+    import random as _random
     import time as _time
-    failure = None
-    while failure is None and any(p.poll() is None for p in procs):
-        failure = next((p.returncode for p in procs
-                        if p.returncode not in (None, 0)), None)
-        if failure is None:
-            _time.sleep(0.2)
-    if failure is None:
-        failure = next((p.returncode for p in procs if p.returncode), None)
-    if failure is not None:
-        for p in procs:
-            if p.poll() is None:
-                p.terminate()
-        for p in procs:
-            try:
-                p.wait(timeout=15)
-            except subprocess.TimeoutExpired:
-                p.kill()
-        return failure
-    return 0
+
+    procs = list(procs)
+    labels = (list(labels) if labels is not None
+              else [f"rank {r}" for r in range(len(procs))])
+    restarts = [0] * len(procs)
+    done = [False] * len(procs)
+
+    def say(msg):
+        print(f"bfrun-tpu: {msg}", file=sys.stderr, flush=True)
+
+    while True:
+        all_done = True
+        for rank, p in enumerate(procs):
+            if done[rank]:
+                continue
+            code = p.poll()
+            if code is None:
+                all_done = False
+                continue
+            if code == 0:
+                done[rank] = True
+                continue
+            say(f"{labels[rank]} exited with code {code}")
+            if respawn is not None and restarts[rank] < restart_limit:
+                restarts[rank] += 1
+                delay = restart_backoff * (2 ** (restarts[rank] - 1))
+                delay *= 1.0 + 0.25 * _random.Random(
+                    f"bfrun:{rank}:{restarts[rank]}").random()
+                say(f"restarting {labels[rank]} (attempt {restarts[rank]}"
+                    f"/{restart_limit}) after {delay:.2f} s backoff")
+                _time.sleep(delay)
+                procs[rank] = respawn(rank, restarts[rank])
+                _count_restart()
+                all_done = False
+                continue
+            # out of restart budget (or restarts disabled): tear down the
+            # survivors, reporting any that die non-zero on the way out
+            for r, q in enumerate(procs):
+                if r != rank and not done[r] and q.poll() is None:
+                    q.terminate()
+            for r, q in enumerate(procs):
+                if r == rank or done[r]:
+                    continue
+                try:
+                    q.wait(timeout=15)
+                except subprocess.TimeoutExpired:
+                    q.kill()
+                    q.wait()
+                if q.returncode:
+                    say(f"{labels[r]} exited with code {q.returncode} "
+                        "during teardown")
+            say(f"job failed: {labels[rank]} exited with code {code}"
+                + (f" after {restarts[rank]} restart(s)"
+                   if restarts[rank] else ""))
+            return code
+        if all_done:
+            return 0
+        _time.sleep(poll_interval)
 
 
 def _interactive_cluster(args, env) -> int:
@@ -414,20 +501,29 @@ def _interactive_cluster(args, env) -> int:
     return 0
 
 
+def _spawn_local_worker(pid, n, coordinator, env, cmd, restart_count=0):
+    """Spawn ONE local rank of an n-process jax.distributed group.
+
+    ``restart_count > 0`` marks an elastic respawn: the child sees
+    ``BLUEFOG_RESTART_COUNT`` so training scripts can branch (e.g. resume
+    via ``checkpoint.restore_latest`` rather than cold-start)."""
+    penv = dict(env)
+    penv.update({
+        "JAX_PLATFORMS": env.get("JAX_PLATFORMS", "cpu"),
+        "BLUEFOG_COORDINATOR": coordinator,
+        "BLUEFOG_NUM_PROCESSES": str(n),
+        "BLUEFOG_PROCESS_ID": str(pid),
+    })
+    if restart_count:
+        penv["BLUEFOG_RESTART_COUNT"] = str(restart_count)
+    return subprocess.Popen(cmd, env=penv)
+
+
 def _spawn_local_workers(n, coordinator, env, cmd):
     """Spawn N local processes wired into one jax.distributed group (the
     `mpirun -np N` stand-in shared by the batch and interactive paths)."""
-    procs = []
-    for pid in range(n):
-        penv = dict(env)
-        penv.update({
-            "JAX_PLATFORMS": env.get("JAX_PLATFORMS", "cpu"),
-            "BLUEFOG_COORDINATOR": coordinator,
-            "BLUEFOG_NUM_PROCESSES": str(n),
-            "BLUEFOG_PROCESS_ID": str(pid),
-        })
-        procs.append(subprocess.Popen(cmd, env=penv))
-    return procs
+    return [_spawn_local_worker(pid, n, coordinator, env, cmd)
+            for pid in range(n)]
 
 
 def _apply_coordinator_env(args, env) -> None:
@@ -552,11 +648,15 @@ def main(argv: Optional[List[str]] = None) -> int:
         # local multi-process emulation: each process sees a slice of a
         # virtual CPU device mesh via jax.distributed (testing path; plays
         # the role of `mpirun -np N` on one machine)
-        procs = _spawn_local_workers(
-            args.num_local_processes,
-            args.coordinator or "127.0.0.1:48291", env, cmd)
-        codes = [p.wait() for p in procs]   # wait on ALL before deciding
-        return next((c for c in codes if c), 0)
+        n = args.num_local_processes
+        coordinator = args.coordinator or "127.0.0.1:48291"
+        procs = _spawn_local_workers(n, coordinator, env, cmd)
+        return _supervise_procs(
+            procs,
+            respawn=lambda rank, count: _spawn_local_worker(
+                rank, n, coordinator, env, cmd, restart_count=count),
+            restart_limit=args.restart_limit,
+            restart_backoff=args.restart_backoff)
 
     if args.coordinator:
         _apply_coordinator_env(args, env)

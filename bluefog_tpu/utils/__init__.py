@@ -13,6 +13,7 @@ from .utility import (
 )
 from .config import env_flag, env_int, env_float
 from .watchdog import synchronize_with_watchdog
+from . import chaos
 
 __all__ = [
     "timeline_start_activity", "timeline_end_activity", "timeline_context",
@@ -24,4 +25,5 @@ __all__ = [
     "broadcast_optimizer_state",
     "env_flag", "env_int", "env_float",
     "synchronize_with_watchdog",
+    "chaos",
 ]

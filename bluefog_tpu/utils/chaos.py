@@ -1,0 +1,373 @@
+"""Deterministic fault injection for the decentralized runtime.
+
+The robustness counterpart of the reference's *accidental* failure modes
+(SURVEY.md §5: a hung MPI rank, a NaN-ed tensor, a preempted host): instead
+of waiting for production to produce them, a **chaos plan** injects them on
+purpose, deterministically, so the healing/rollback/restart machinery in
+:mod:`bluefog_tpu.resilience` and the launcher can be exercised — and its
+telemetry asserted — in CI.
+
+A plan is a seeded list of faults parsed from the ``BLUEFOG_CHAOS`` env var
+(or built programmatically).  Grammar — ``;``-separated clauses, each
+``kind:key=value,...``::
+
+    BLUEFOG_CHAOS="seed=42;kill:step=30,rank=3;nan:step=10,rank=2"
+    BLUEFOG_CHAOS="hang:step=5,t=2.5;throttle:from=7,until=20,t=0.05"
+    BLUEFOG_CHAOS="nan:op=neighbor_allreduce,call=3,rank=1;kill:p=0.001"
+
+Fault kinds (reference failure modes they emulate):
+
+- ``kill``     — raise :class:`RankKilled` (a dead rank / preempted host).
+  In a launcher child the uncaught exception exits the process non-zero,
+  which is exactly what ``bfrun-tpu --restart-limit`` supervises.
+- ``hang``     — sleep ``t`` seconds once (a wedged ICI link / stuck host;
+  the watchdog's ``timeout=`` escalation is the detector).
+- ``throttle`` — sleep ``t`` seconds every step in ``[from, until]`` (a
+  straggler).
+- ``nan``      — corrupt rank ``rank``'s payload shard to NaN (a numerics
+  blow-up; the non-finite guard + rollback in ``resilience`` is the
+  detector/response).
+
+Matching sites: faults with ``op=``/``call=`` match eager op dispatches
+(``api.py`` / ``parallel/windows.py``); all others match the train-step
+wrapper's call counter (``optimizers._InstrumentedStep``).  ``step``/``call``
+are 1-based.  ``p=`` arms a fault probabilistically per step with a
+seed-derived draw, so the *same* plan produces the *same* fault sequence on
+every rank and every rerun — chaos runs are reproducible by construction.
+
+Zero overhead when unset: the hook sites check the module-level ``_plan``
+attribute inline and do nothing else when no plan is installed — no parsing,
+no matching, no allocation on the step path.  jax / the metrics registry /
+the timeline are imported lazily so launcher children can import this module
+without paying the jax import.
+"""
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+__all__ = [
+    "Fault", "ChaosPlan", "RankKilled",
+    "install", "uninstall", "active", "current_plan",
+    "maybe_install_from_env", "on_train_step", "corrupt_train_output",
+    "on_eager_op",
+]
+
+ENV_VAR = "BLUEFOG_CHAOS"
+DEFAULT_KILL_CODE = 43
+
+_KINDS = ("kill", "hang", "throttle", "nan")
+
+
+class RankKilled(RuntimeError):
+    """A chaos ``kill`` fault fired: the targeted rank is dead.
+
+    In a multi-process job the uncaught exception takes the process down
+    (non-zero exit — the launcher's restart supervisor picks it up); in a
+    single-process SPMD simulation the training loop catches it and hands
+    ``rank`` to :func:`bluefog_tpu.resilience.mark_rank_dead`.
+    """
+
+    def __init__(self, rank: Optional[int], step: int,
+                 code: int = DEFAULT_KILL_CODE):
+        self.rank = rank
+        self.step = step
+        self.code = code
+        super().__init__(
+            f"chaos: rank {'*' if rank is None else rank} killed at "
+            f"step {step} (exit code {code})")
+
+
+@dataclass(frozen=True)
+class Fault:
+    """One fault clause.  ``step`` doubles as the throttle window start."""
+    kind: str
+    step: Optional[int] = None       # train-step index (1-based)
+    until: Optional[int] = None      # throttle window end (inclusive)
+    call: Optional[int] = None       # eager-op call index (1-based, per op)
+    op: Optional[str] = None         # eager op name ("*" matches any op)
+    rank: Optional[int] = None       # target rank (None = caller decides)
+    t: float = 0.0                   # hang/throttle sleep seconds
+    p: Optional[float] = None        # seeded per-step probability
+    code: int = DEFAULT_KILL_CODE    # kill exit code
+
+    def __post_init__(self):
+        if self.kind not in _KINDS:
+            raise ValueError(
+                f"unknown chaos fault kind {self.kind!r} (expected one of "
+                f"{_KINDS})")
+        if self.kind in ("hang", "throttle") and self.t <= 0:
+            raise ValueError(f"{self.kind} fault needs t=<seconds> > 0")
+        if self.kind == "nan" and self.rank is None:
+            raise ValueError("nan fault needs rank=<target rank>")
+        if (self.step is None and self.call is None and self.p is None
+                and self.op is None):
+            raise ValueError(
+                f"{self.kind} fault needs a trigger: step=, call=/op=, or p=")
+        if self.p is not None and not (0.0 < self.p <= 1.0):
+            raise ValueError(f"p must be in (0, 1], got {self.p}")
+
+    @property
+    def is_op_fault(self) -> bool:
+        return self.op is not None or self.call is not None
+
+
+class ChaosPlan:
+    """A seeded, immutable fault list plus the mutable match counters."""
+
+    def __init__(self, faults: List[Fault], seed: int = 0):
+        self.faults: Tuple[Fault, ...] = tuple(faults)
+        self.seed = int(seed)
+        self._op_calls: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    # -- parsing ----------------------------------------------------------
+    _INT_KEYS = ("step", "until", "call", "rank", "code")
+    _FLOAT_KEYS = ("t", "p")
+
+    @classmethod
+    def parse(cls, spec: str) -> "ChaosPlan":
+        """Parse the ``BLUEFOG_CHAOS`` grammar (see module docstring)."""
+        seed = 0
+        faults: List[Fault] = []
+        for clause in spec.split(";"):
+            clause = clause.strip()
+            if not clause:
+                continue
+            if ":" not in clause:
+                key, _, val = clause.partition("=")
+                if key.strip() != "seed" or not val:
+                    raise ValueError(
+                        f"bad chaos clause {clause!r}: expected 'seed=N' or "
+                        "'kind:key=value,...'")
+                seed = int(val)
+                continue
+            kind, _, body = clause.partition(":")
+            kw: dict = {}
+            for item in body.split(","):
+                item = item.strip()
+                if not item:
+                    continue
+                key, sep, val = item.partition("=")
+                if not sep:
+                    raise ValueError(
+                        f"bad chaos parameter {item!r} in {clause!r} "
+                        "(expected key=value)")
+                key = key.strip()
+                if key == "from":           # throttle window start
+                    key = "step"
+                if key in cls._INT_KEYS:
+                    kw[key] = int(val)
+                elif key in cls._FLOAT_KEYS:
+                    kw[key] = float(val)
+                elif key == "op":
+                    kw[key] = val.strip()
+                else:
+                    raise ValueError(
+                        f"unknown chaos parameter {key!r} in {clause!r}")
+            faults.append(Fault(kind=kind.strip(), **kw))
+        return cls(faults, seed=seed)
+
+    # -- matching ---------------------------------------------------------
+    def _draw(self, fault_index: int, fault: Fault, tick: int) -> bool:
+        """Seed-derived Bernoulli draw — identical across ranks and reruns."""
+        r = random.Random(
+            f"{self.seed}:{fault_index}:{fault.kind}:{tick}").random()
+        return r < fault.p  # type: ignore[operator]
+
+    def match_step(self, step: int) -> List[Fault]:
+        out = []
+        for i, f in enumerate(self.faults):
+            if f.is_op_fault:
+                continue
+            if f.kind == "throttle":
+                start = f.step if f.step is not None else 1
+                if start <= step <= (f.until if f.until is not None
+                                     else float("inf")):
+                    out.append(f)
+                continue
+            if f.step is not None and f.step == step:
+                out.append(f)
+            elif f.step is None and f.p is not None and self._draw(i, f, step):
+                out.append(f)
+        return out
+
+    def bump_op(self, op_name: str) -> int:
+        with self._lock:
+            n = self._op_calls.get(op_name, 0) + 1
+            self._op_calls[op_name] = n
+            return n
+
+    def match_op(self, op_name: str, call: int) -> List[Fault]:
+        out = []
+        for i, f in enumerate(self.faults):
+            if not f.is_op_fault:
+                continue
+            if f.op not in (None, "*", op_name):
+                continue
+            if f.call is not None and f.call != call:
+                continue
+            if f.call is None and f.p is not None:
+                if not self._draw(i, f, call):
+                    continue
+            elif f.call is None and f.p is None:
+                continue          # op= alone with neither call= nor p=
+            out.append(f)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Module plan slot (the zero-overhead gate: hook sites read this attribute)
+# ---------------------------------------------------------------------------
+
+_plan: Optional[ChaosPlan] = None
+
+
+def install(plan) -> ChaosPlan:
+    """Install a :class:`ChaosPlan` (or a grammar string) process-wide."""
+    global _plan
+    if isinstance(plan, str):
+        plan = ChaosPlan.parse(plan)
+    if not isinstance(plan, ChaosPlan):
+        raise TypeError(f"expected ChaosPlan or spec string, got {plan!r}")
+    _plan = plan
+    return plan
+
+
+def uninstall() -> None:
+    global _plan
+    _plan = None
+
+
+def active() -> bool:
+    return _plan is not None
+
+
+def current_plan() -> Optional[ChaosPlan]:
+    return _plan
+
+
+def maybe_install_from_env() -> bool:
+    """Honor ``BLUEFOG_CHAOS`` at init (no-op when unset or already armed)."""
+    spec = os.environ.get(ENV_VAR)
+    if not spec or _plan is not None:
+        return False
+    install(spec)
+    return True
+
+
+# ---------------------------------------------------------------------------
+# Telemetry (lazy imports: launcher children import this module without jax)
+# ---------------------------------------------------------------------------
+
+def _record_fault(fault: Fault, site: str, dur_s: float = 0.0) -> None:
+    try:
+        from . import metrics as _metrics
+        from . import timeline as _tl
+    except Exception:                                      # pragma: no cover
+        return
+    _metrics.counter(
+        "bluefog_faults_injected_total",
+        "chaos faults injected, by kind").inc(kind=fault.kind)
+    now_us = _tl._now_us()
+    _tl.record_span(f"chaos:{site}", "FAULT",
+                    now_us - dur_s * 1e6, max(dur_s * 1e6, 1.0))
+
+
+def _enact(fault: Fault, site: str, tick: int) -> None:
+    """Apply a kill/hang/throttle fault (nan is handled by the corruptors)."""
+    if fault.kind == "kill":
+        _record_fault(fault, site)
+        raise RankKilled(fault.rank, tick, fault.code)
+    if fault.kind in ("hang", "throttle"):
+        _record_fault(fault, site, dur_s=fault.t)
+        time.sleep(fault.t)
+
+
+# ---------------------------------------------------------------------------
+# NaN corruption (private program cache: an injected fault must not trip the
+# retrace sentinel — corrupting a payload is an anomaly, not a retrace)
+# ---------------------------------------------------------------------------
+
+_corrupt_programs: Dict[tuple, object] = {}
+
+
+def _corrupt_distributed(x, rank: int):
+    """NaN rank ``rank``'s shard of a distributed array (leading rank axis)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel import context as _mesh
+
+    if not _mesh.is_initialized():
+        return x
+    ctx = _mesh.get_context()
+    if (getattr(x, "ndim", 0) < 1 or x.shape[0] != ctx.size
+            or not jnp.issubdtype(x.dtype, jnp.floating)):
+        return x
+    key = (ctx.mesh, tuple(x.shape), x.dtype.name, int(rank))
+    fn = _corrupt_programs.get(key)
+    if fn is None:
+        from ..ops import collectives as _coll
+
+        def per_rank(block):
+            return _coll.corrupt_payload(block, rank, axis="rank")
+
+        fn = jax.jit(jax.shard_map(
+            per_rank, mesh=ctx.mesh, in_specs=P("rank"), out_specs=P("rank")))
+        _corrupt_programs[key] = fn
+    return fn(x)
+
+
+def _corrupt_tree(tree, rank: int):
+    import jax
+    return jax.tree.map(lambda leaf: _corrupt_distributed(leaf, rank), tree)
+
+
+# ---------------------------------------------------------------------------
+# Hook entry points (call sites gate on `_plan is not None` themselves)
+# ---------------------------------------------------------------------------
+
+def on_train_step(step: int) -> None:
+    """Pre-dispatch train-step hook: may sleep (hang/throttle) or raise
+    :class:`RankKilled`.  Called by ``optimizers._InstrumentedStep``."""
+    plan = _plan
+    if plan is None:
+        return
+    for f in plan.match_step(step):
+        if f.kind != "nan":
+            _enact(f, "train_step", step)
+
+
+def corrupt_train_output(out, step: int):
+    """Post-dispatch train-step hook: NaN-corrupt the target rank's shard of
+    the step outputs (donation-safe: only outputs are touched)."""
+    plan = _plan
+    if plan is None:
+        return out
+    for f in plan.match_step(step):
+        if f.kind == "nan":
+            _record_fault(f, "train_step")
+            out = _corrupt_tree(out, f.rank)
+    return out
+
+
+def on_eager_op(op_name: str, out):
+    """Eager-dispatch hook (``api._dispatch`` / ``parallel.windows._move``):
+    counts this op's call, then kills / sleeps / corrupts per the plan."""
+    plan = _plan
+    if plan is None:
+        return out
+    call = plan.bump_op(op_name)
+    for f in plan.match_op(op_name, call):
+        if f.kind == "nan":
+            _record_fault(f, op_name)
+            out = _corrupt_tree(out, f.rank)
+        else:
+            _enact(f, op_name, call)
+    return out

@@ -543,4 +543,14 @@ def metrics_summary() -> dict:
     out["retrace_after_warmup"] = _counter_total(
         "bluefog_retrace_after_warmup_total")
     out["watchdog_stalls"] = _counter_total("bluefog_watchdog_stalls_total")
+    resilience = {
+        "faults_injected": _counter_total("bluefog_faults_injected_total"),
+        "nonfinite_steps": _counter_total("bluefog_nonfinite_steps_total"),
+        "rank_restarts": _counter_total("bluefog_rank_restarts_total"),
+        "watchdog_timeouts": _counter_total(
+            "bluefog_watchdog_timeouts_total"),
+        "dead_ranks": _gauge_val("bluefog_dead_ranks"),
+    }
+    if any(v for v in resilience.values()):
+        out["resilience"] = resilience
     return out

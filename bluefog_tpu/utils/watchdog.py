@@ -29,6 +29,7 @@ def synchronize_with_watchdog(
     x: Any,
     interval: float = DEFAULT_INTERVAL_S,
     name: str = "step",
+    timeout: Optional[float] = None,
 ) -> Any:
     """``jax.block_until_ready(x)`` that complains while it waits.
 
@@ -41,14 +42,23 @@ def synchronize_with_watchdog(
     timeline is active the waited interval is recorded as a ``STALL``
     activity span — so a stalled job is visible on the dashboard and in
     the trace, not just in the log.
+
+    ``timeout`` escalates from warnings to failure: after ``timeout``
+    seconds without completion a :class:`TimeoutError` is raised (naming
+    the computation and how many stall-warning intervals elapsed), so a
+    supervisor — the resilience layer, or ``bfrun-tpu``'s restart logic —
+    can treat the rank as dead instead of waiting forever.  The underlying
+    device computation cannot be cancelled from Python; the blocking wait
+    is abandoned on a daemon thread.  Default (``None``) keeps the
+    warn-forever behavior.
     """
     done = threading.Event()
     t0 = time.monotonic()
+    stalls = [0]                     # shared with the watch loop
 
     def watch():
-        n = 0
         while not done.wait(interval):
-            n += 1
+            stalls[0] += 1
             waited = time.monotonic() - t0
             logger.warning(
                 "%s has not completed after %.0f s — one or more devices/"
@@ -63,7 +73,38 @@ def synchronize_with_watchdog(
 
     t = threading.Thread(target=watch, daemon=True)
     t.start()
+    if timeout is None:
+        try:
+            return jax.block_until_ready(x)
+        finally:
+            done.set()
+
+    # Escalation path: block on a helper thread so this thread can give up.
+    result: dict = {}
+    finished = threading.Event()
+
+    def block():
+        try:
+            result["value"] = jax.block_until_ready(x)
+        except BaseException as e:                 # surface on caller thread
+            result["error"] = e
+        finally:
+            finished.set()
+
+    blocker = threading.Thread(target=block, daemon=True)
+    blocker.start()
     try:
-        return jax.block_until_ready(x)
+        if not finished.wait(timeout):
+            waited = time.monotonic() - t0
+            _metrics.counter(
+                "bluefog_watchdog_timeouts_total",
+                "watchdog waits that hit their timeout").inc(name=name)
+            raise TimeoutError(
+                f"{name} did not complete within {timeout:g} s (waited "
+                f"{waited:.1f} s; {stalls[0]} stall-warning interval(s) of "
+                f"{interval:g} s elapsed) — treating the computation as hung")
+        if "error" in result:
+            raise result["error"]
+        return result["value"]
     finally:
         done.set()

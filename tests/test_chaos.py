@@ -1,0 +1,215 @@
+"""Deterministic fault injection: plan grammar, seeded matching, the eager
+and train-step hook sites, telemetry, and the chaos-off zero-overhead pin.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import optax
+import pytest
+
+import bluefog_tpu as bf
+from bluefog_tpu import optimizers as bfopt
+from bluefog_tpu import topology as tu
+from bluefog_tpu.utils import chaos
+from bluefog_tpu.utils import metrics as bfm
+
+N, D = 8, 16
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    bfm.reset_metrics()
+    chaos.uninstall()
+    yield
+    chaos.uninstall()
+    bfm.stop_metrics()
+    bfm.reset_metrics()
+
+
+@pytest.fixture
+def ctx(cpu_devices):
+    bf.init(devices=cpu_devices)
+    bf.set_topology(tu.ExponentialTwoGraph(N), is_weighted=True)
+    yield
+    bf.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Plan grammar + validation
+# ---------------------------------------------------------------------------
+
+def test_parse_full_grammar():
+    p = chaos.ChaosPlan.parse(
+        "seed=42; kill:step=30,rank=3,code=7; nan:step=10,rank=2; "
+        "hang:step=5,t=2.5; throttle:from=7,until=20,t=0.05; "
+        "nan:op=neighbor_allreduce,call=3,rank=1")
+    assert p.seed == 42 and len(p.faults) == 5
+    kill, nan1, hang, thr, nan2 = p.faults
+    assert (kill.kind, kill.step, kill.rank, kill.code) == ("kill", 30, 3, 7)
+    assert (nan1.kind, nan1.step, nan1.rank) == ("nan", 10, 2)
+    assert (hang.kind, hang.step, hang.t) == ("hang", 5, 2.5)
+    assert (thr.kind, thr.step, thr.until, thr.t) == ("throttle", 7, 20, 0.05)
+    assert (nan2.op, nan2.call, nan2.rank) == ("neighbor_allreduce", 3, 1)
+    assert not kill.is_op_fault and nan2.is_op_fault
+
+
+@pytest.mark.parametrize("bad, msg", [
+    ("explode:step=1", "unknown chaos fault kind"),
+    ("hang:step=1", "needs t="),
+    ("throttle:from=1,until=2", "needs t="),
+    ("nan:step=1", "needs rank="),
+    ("kill:", "needs a trigger"),
+    ("kill:p=1.5", "p must be in"),
+    ("kill:step=1,zap=2", "unknown chaos parameter"),
+    ("kill:step", "expected key=value"),
+    ("seedling=3", "expected 'seed=N'"),
+])
+def test_parse_rejects_bad_clauses(bad, msg):
+    with pytest.raises(ValueError, match=msg):
+        chaos.ChaosPlan.parse(bad)
+
+
+def test_seeded_probabilistic_match_is_deterministic():
+    spec = "seed=7;kill:p=0.1"
+    a = chaos.ChaosPlan.parse(spec)
+    b = chaos.ChaosPlan.parse(spec)
+    hits_a = [s for s in range(1, 2000) if a.match_step(s)]
+    hits_b = [s for s in range(1, 2000) if b.match_step(s)]
+    assert hits_a == hits_b and hits_a          # same draws, and some fire
+    # a different seed produces a different (still deterministic) sequence
+    c = chaos.ChaosPlan.parse("seed=8;kill:p=0.1")
+    assert [s for s in range(1, 2000) if c.match_step(s)] != hits_a
+
+
+def test_step_and_op_matching():
+    p = chaos.ChaosPlan.parse(
+        "kill:step=3;throttle:from=2,until=4,t=0.01;"
+        "nan:op=neighbor_allreduce,call=2,rank=1;hang:op=*,call=5,t=0.01")
+    assert [f.kind for f in p.match_step(3)] == ["kill", "throttle"]
+    assert [f.kind for f in p.match_step(2)] == ["throttle"]
+    assert p.match_step(5) == []
+    assert p.match_op("neighbor_allreduce", 1) == []
+    assert [f.kind for f in p.match_op("neighbor_allreduce", 2)] == ["nan"]
+    assert p.match_op("allreduce", 2) == []     # op name must match
+    assert [f.kind for f in p.match_op("allreduce", 5)] == ["hang"]  # op=*
+    assert p.bump_op("x") == 1 and p.bump_op("x") == 2 and p.bump_op("y") == 1
+
+
+def test_install_uninstall_and_env(monkeypatch):
+    assert not chaos.active()
+    plan = chaos.install("kill:step=1")
+    assert chaos.active() and chaos.current_plan() is plan
+    chaos.uninstall()
+    assert not chaos.active()
+    with pytest.raises(TypeError):
+        chaos.install(42)
+    monkeypatch.setenv(chaos.ENV_VAR, "nan:step=2,rank=0")
+    assert chaos.maybe_install_from_env()
+    assert chaos.current_plan().faults[0].kind == "nan"
+    assert not chaos.maybe_install_from_env()   # already armed: no-op
+    chaos.uninstall()
+    monkeypatch.delenv(chaos.ENV_VAR)
+    assert not chaos.maybe_install_from_env()
+
+
+def test_init_arms_plan_from_env(monkeypatch, cpu_devices):
+    monkeypatch.setenv(chaos.ENV_VAR, "kill:step=99")
+    bf.init(devices=cpu_devices)
+    try:
+        assert chaos.active()
+    finally:
+        bf.shutdown()
+    assert not chaos.active()                   # shutdown disarms
+
+
+# ---------------------------------------------------------------------------
+# Hook sites
+# ---------------------------------------------------------------------------
+
+def test_eager_op_nan_injection(ctx):
+    chaos.install("nan:op=neighbor_allreduce,call=2,rank=1")
+    x = bf.shard_distributed(jnp.ones((N, D), jnp.float32))
+    out1 = bf.synchronize(bf.neighbor_allreduce(x))
+    assert bool(jnp.isfinite(out1).all())       # call 1: untouched
+    out2 = np.asarray(bf.synchronize(bf.neighbor_allreduce(x)))
+    assert np.isnan(out2[1]).all()              # call 2: rank 1's shard NaN
+    mask = np.ones(N, bool)
+    mask[1] = False
+    assert np.isfinite(out2[mask]).all()        # every other rank untouched
+    assert bfm.counter("bluefog_faults_injected_total").value(
+        kind="nan") == 1
+
+
+def test_eager_op_kill_raises(ctx):
+    chaos.install("kill:op=allreduce,call=1,rank=2")
+    x = bf.shard_distributed(jnp.ones((N, D), jnp.float32))
+    with pytest.raises(chaos.RankKilled) as ei:
+        bf.allreduce(x)
+    assert ei.value.rank == 2
+    assert ei.value.code == chaos.DEFAULT_KILL_CODE
+    assert bfm.counter("bluefog_faults_injected_total").value(
+        kind="kill") == 1
+
+
+def _lr0_step(metrics_every_k=None):
+    strat = bfopt.adapt_with_combine(
+        optax.sgd(0.0), bfopt.neighbor_communicator(bf.static_schedule()))
+    params = {"w": jnp.broadcast_to(
+        jnp.arange(float(N))[:, None], (N, D)).astype(jnp.float32)}
+    state = bfopt.init_distributed(strat, params)
+    step = bfopt.make_train_step(
+        lambda p, b: (jnp.mean((p["w"] - b) ** 2),
+                      jax.grad(lambda q: jnp.mean((q["w"] - b) ** 2))(p)),
+        strat, metrics_every_k=metrics_every_k)
+    return step, params, state, jnp.zeros((N, D), jnp.float32)
+
+
+def test_train_step_kill_and_throttle(ctx):
+    chaos.install("throttle:from=1,until=2,t=0.01;kill:step=3,rank=5")
+    step, params, state, batch = _lr0_step()
+    for _ in range(2):
+        params, state, loss = step(params, state, batch)
+    with pytest.raises(chaos.RankKilled) as ei:
+        step(params, state, batch)
+    assert ei.value.rank == 5 and ei.value.step == 3
+    c = bfm.counter("bluefog_faults_injected_total")
+    assert c.value(kind="throttle") == 2 and c.value(kind="kill") == 1
+
+
+def test_train_step_nan_corrupts_only_target_rank_output(ctx):
+    chaos.install("nan:step=2,rank=4")
+    step, params, state, batch = _lr0_step()
+    params, state, loss = step(params, state, batch)
+    assert bool(jnp.isfinite(params["w"]).all())
+    params, state, loss = step(params, state, batch)
+    w = np.asarray(params["w"])
+    assert np.isnan(w[4]).all()
+    mask = np.ones(N, bool)
+    mask[4] = False
+    assert np.isfinite(w[mask]).all()
+
+
+# ---------------------------------------------------------------------------
+# The chaos-off contract: no overhead, no retrace, no telemetry
+# ---------------------------------------------------------------------------
+
+def test_chaos_off_is_inert_and_retrace_free(ctx):
+    """With no plan installed the hook sites reduce to one attribute load:
+    the training loop keeps full donation and ZERO compilations after
+    warmup (the PR's no-overhead acceptance pin), and no fault telemetry
+    ever appears."""
+    assert chaos.current_plan() is None
+    step, params, state, batch = _lr0_step(metrics_every_k=2)
+    sizes, w1 = [], None
+    for i in range(6):
+        params, state, loss = step(params, state, batch)
+        jax.block_until_ready(loss)
+        sizes.append(step._jit_cache_len())
+        if i == 0:
+            w1 = params["w"]
+    assert w1.is_deleted()                       # donation intact
+    assert sizes[1] is not None and sizes[-1] == sizes[1], sizes
+    assert bfm.counter("bluefog_retrace_after_warmup_total").total() == 0
+    assert bfm.counter("bluefog_faults_injected_total").total() == 0
+    ms = bfm.metrics_summary()
+    assert "resilience" not in ms                # block omitted when clean

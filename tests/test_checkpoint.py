@@ -199,3 +199,83 @@ def test_async_saver_roundtrip(tmp_path):
     assert at == 2
     np.testing.assert_array_equal(np.asarray(out2["w"]),
                                   np.asarray(tree["w"]) + 1)
+
+
+# ---------------------------------------------------------------------------
+# Completion markers: partial writes are invisible to resume
+# ---------------------------------------------------------------------------
+
+def test_partial_checkpoint_skipped(tmp_path):
+    """A step_* directory truncated mid-write (killed rank) is skipped by
+    all_steps/latest_step/restore_latest — the elastic-restart contract."""
+    tree = {"x": jnp.zeros((N, 2))}
+    ckpt.save(str(tmp_path), tree, step=1)
+    ckpt.save(str(tmp_path), tree, step=2)
+    torn = tmp_path / "step_3"
+    torn.mkdir()
+    (torn / "arrays").write_text("truncated mid-write")
+    assert ckpt.all_steps(str(tmp_path)) == [1, 2]
+    assert ckpt.all_steps(str(tmp_path), include_incomplete=True) == [1, 2, 3]
+    assert ckpt.latest_step(str(tmp_path)) == 2
+    assert not ckpt.is_complete(str(torn))
+    out, at = ckpt.restore_latest(str(tmp_path), template=tree)
+    assert at == 2 and out is not None
+    # orbax's own GCS-style commit file counts as completion too
+    (torn / "commit_success.txt").write_text("ok")
+    assert ckpt.latest_step(str(tmp_path)) == 3
+
+
+def test_prune_ignores_unmarked_inflight_dirs(tmp_path):
+    """``keep`` counts and deletes only COMPLETE checkpoints: an unmarked
+    directory might be another process's save still in flight."""
+    tree = {"x": jnp.zeros((N, 2))}
+    inflight = tmp_path / "step_0"
+    inflight.mkdir()
+    (inflight / "partial").write_text("another process, still writing")
+    for s in (1, 2, 3):
+        ckpt.save(str(tmp_path), tree, step=s, keep=2)
+    assert ckpt.all_steps(str(tmp_path)) == [2, 3]
+    assert inflight.is_dir()                       # never deleted
+
+
+def test_async_saver_surfaces_background_errors(tmp_path):
+    """A failed background write raises at the NEXT save()/wait() call and
+    never gets a completion marker (silent half-written checkpoints are
+    exactly what restore_latest must not see)."""
+    import os
+
+    class FakeAsync:
+        def __init__(self):
+            self.error = None
+
+        def save(self, path, state, force=True):
+            os.makedirs(path, exist_ok=True)
+
+        def wait_until_finished(self):
+            pass
+
+        def check_for_errors(self):
+            if self.error:
+                raise RuntimeError(self.error)
+
+        def close(self):
+            pass
+
+    saver = ckpt.AsyncSaver.__new__(ckpt.AsyncSaver)
+    fake = FakeAsync()
+    saver._ckpt = fake
+    saver._pending = []
+    tree = {"x": jnp.zeros((N, 2))}
+    p1 = saver.save(str(tmp_path), tree, step=1)
+    fake.error = "disk full on background write"   # the async write "fails"
+    with pytest.raises(RuntimeError, match="disk full"):
+        saver.save(str(tmp_path), tree, step=2)
+    # the failed-in-flight save never got its completion marker
+    assert not ckpt.is_complete(p1)
+    assert ckpt.all_steps(str(tmp_path)) == []
+    with pytest.raises(RuntimeError, match="disk full"):
+        saver.wait()
+    # once the error clears, wait() finalizes what actually landed
+    fake.error = None
+    saver.wait()
+    assert ckpt.all_steps(str(tmp_path)) == [1]

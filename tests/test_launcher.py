@@ -277,3 +277,119 @@ def test_check_environment(capsys):
     lines = out.strip().splitlines()
     assert lines[-2].startswith("probing devices")     # probe is last
     assert lines[-1].startswith("devices: ")
+
+
+# ---------------------------------------------------------------------------
+# Supervisor: failure diagnosis + elastic restart
+# ---------------------------------------------------------------------------
+
+def test_local_failure_names_rank_and_code(tmp_path, capsys):
+    """mpirun teardown loses WHICH rank died with WHICH code; ours must
+    say both, terminate the sleeper instead of awaiting it, and name the
+    failing rank again in the final error line."""
+    import sys
+    import time
+    t0 = time.perf_counter()
+    code = launcher.main(
+        ["-np", "2", "--",
+         sys.executable, "-c",
+         "import os,sys,time\n"
+         "sys.exit(5) if os.environ['BLUEFOG_PROCESS_ID'] == '0' "
+         "else time.sleep(600)"])
+    assert code == 5
+    assert time.perf_counter() - t0 < 60
+    err = capsys.readouterr().err
+    assert "rank 0 exited with code 5" in err
+    assert "job failed: rank 0 exited with code 5" in err
+
+
+def test_restart_limit_respawns_dead_rank(capsys):
+    """--restart-limit: a rank exiting non-zero is respawned (with
+    BLUEFOG_RESTART_COUNT set) instead of killing the job; the respawn is
+    counted in bluefog_rank_restarts_total."""
+    import sys
+
+    from bluefog_tpu.utils import metrics as bfm
+    bfm.reset_metrics()
+    code = launcher.main(
+        ["-np", "1", "--restart-limit", "2", "--restart-backoff", "0.01",
+         "--", sys.executable, "-c",
+         "import os,sys; sys.exit(0 if os.environ.get("
+         "'BLUEFOG_RESTART_COUNT') else 9)"])
+    assert code == 0
+    err = capsys.readouterr().err
+    assert "rank 0 exited with code 9" in err
+    assert "restarting rank 0 (attempt 1/2)" in err
+    assert bfm.counter("bluefog_rank_restarts_total").total() == 1
+    bfm.reset_metrics()
+
+
+def test_restart_limit_exhausted_fails_with_count(capsys):
+    import sys
+    code = launcher.main(
+        ["-np", "1", "--restart-limit", "1", "--restart-backoff", "0.01",
+         "--", sys.executable, "-c", "import sys; sys.exit(7)"])
+    assert code == 7
+    err = capsys.readouterr().err
+    assert "job failed: rank 0 exited with code 7 after 1 restart(s)" in err
+
+
+def test_multihost_restart_respawns_remote_argv(tmp_path, capsys):
+    """-H fan-out honors --restart-limit too: the dead rank's ssh argv is
+    respawned verbatim while the survivor keeps running."""
+    import sys
+    stub = tmp_path / "fake_ssh"
+    stub.write_text('#!/bin/sh\nshift\nexec sh -c "$@"\n')
+    stub.chmod(0o755)
+    marker = tmp_path / "died_once"
+    code = launcher.main(
+        ["-H", "h1,h2", "--remote-shell", str(stub),
+         "--restart-limit", "1", "--restart-backoff", "0.01", "--",
+         sys.executable, "-c",
+         "import os,sys,pathlib\n"
+         f"m = pathlib.Path('{marker}')\n"
+         "if os.environ['BLUEFOG_PROCESS_ID'] == '1' and not m.exists():\n"
+         "    m.write_text('x'); sys.exit(11)\n"
+         "sys.exit(0)"])
+    assert code == 0
+    err = capsys.readouterr().err
+    assert "rank 1 on h2 exited with code 11" in err
+    assert "restarting rank 1 on h2" in err
+
+
+@pytest.mark.slow
+def test_restart_resumes_from_latest_complete_checkpoint(tmp_path, capsys):
+    """Acceptance (c): the killed rank's respawn resumes from the latest
+    COMPLETE checkpoint — falling past the torn step_3 directory its
+    predecessor died writing — and the job exits 0 within the budget."""
+    import os
+    import sys
+
+    import bluefog_tpu
+    repo = os.path.dirname(os.path.dirname(bluefog_tpu.__file__))
+    ckdir = tmp_path / "ckpts"
+    script = tmp_path / "train_stub.py"
+    script.write_text(
+        "import os, sys\n"
+        "import jax.numpy as jnp\n"
+        "from bluefog_tpu import checkpoint as ckpt\n"
+        "d = sys.argv[1]\n"
+        "if os.environ.get('BLUEFOG_RESTART_COUNT'):\n"
+        "    out, at = ckpt.restore_latest(d)\n"
+        "    assert at == 2, (at, ckpt.all_steps(d, True))\n"
+        "    assert int(out['s']) == 2\n"
+        "    sys.exit(0)\n"
+        "ckpt.save(d, {'s': jnp.asarray(1)}, step=1)\n"
+        "ckpt.save(d, {'s': jnp.asarray(2)}, step=2)\n"
+        "os.makedirs(os.path.join(d, 'step_3'))\n"
+        "with open(os.path.join(d, 'step_3', 'arrays'), 'w') as f:\n"
+        "    f.write('torn mid-write')\n"
+        "sys.exit(9)\n")
+    code = launcher.main(
+        ["-np", "1", "--restart-limit", "1", "--restart-backoff", "0.01",
+         "-x", f"PYTHONPATH={repo}",
+         "--", sys.executable, str(script), str(ckdir)])
+    assert code == 0
+    err = capsys.readouterr().err
+    assert "rank 0 exited with code 9" in err
+    assert "restarting rank 0 (attempt 1/1)" in err

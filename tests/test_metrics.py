@@ -356,6 +356,45 @@ def test_watchdog_happy_path_stays_silent():
     assert bfm.counter("bluefog_watchdog_stalls_total").total() == 0
 
 
+def test_watchdog_timeout_escalates_to_error(monkeypatch):
+    """timeout= turns the warn-forever watchdog into a failure detector:
+    a hung computation raises TimeoutError naming the computation and the
+    stall intervals elapsed, and counts a timeout metric."""
+    monkeypatch.setattr(wd, "jax", types.SimpleNamespace(
+        block_until_ready=lambda x: (time.sleep(10), x)[1]))
+    with pytest.raises(TimeoutError, match=
+                       r"slowstep did not complete within 0\.15 s"):
+        wd.synchronize_with_watchdog(
+            7, interval=0.04, name="slowstep", timeout=0.15)
+    try:
+        wd.synchronize_with_watchdog(
+            7, interval=0.04, name="slowstep", timeout=0.15)
+    except TimeoutError as e:
+        assert "stall-warning interval" in str(e)
+    assert bfm.counter("bluefog_watchdog_timeouts_total").value(
+        name="slowstep") == 2
+
+
+def test_watchdog_timeout_happy_path_unchanged():
+    """A timeout that never fires changes nothing: the value comes back
+    and no timeout metric appears."""
+    out = wd.synchronize_with_watchdog(
+        jnp.ones(()), interval=60.0, name="quick2", timeout=30.0)
+    assert out is not None
+    assert bfm.counter("bluefog_watchdog_timeouts_total").total() == 0
+
+
+def test_watchdog_timeout_path_propagates_errors(monkeypatch):
+    """An error raised by the blocking wait surfaces on the CALLER thread,
+    not swallowed on the helper."""
+    def boom(x):
+        raise ValueError("dead backend")
+    monkeypatch.setattr(wd, "jax", types.SimpleNamespace(
+        block_until_ready=boom))
+    with pytest.raises(ValueError, match="dead backend"):
+        wd.synchronize_with_watchdog(7, name="errpath", timeout=5.0)
+
+
 # ---------------------------------------------------------------------------
 # The acceptance integration test: training loop under full telemetry
 # ---------------------------------------------------------------------------

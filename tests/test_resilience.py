@@ -1,0 +1,300 @@
+"""Topology healing, the non-finite guard, and the kill→heal→contract
+acceptance run: a rank dies mid-training on ExponentialTwoGraph(8), the
+survivors heal around it, and consensus distance keeps contracting
+monotonically on the 7 live ranks with donation intact and zero retraces.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import optax
+import pytest
+
+import bluefog_tpu as bf
+from bluefog_tpu import diagnostics as bfdiag
+from bluefog_tpu import optimizers as bfopt
+from bluefog_tpu import resilience as rz
+from bluefog_tpu import schedule as sch
+from bluefog_tpu import topology as tu
+from bluefog_tpu.utils import chaos
+from bluefog_tpu.utils import metrics as bfm
+
+N, D = 8, 16
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    bfm.reset_metrics()
+    chaos.uninstall()
+    rz.reset()
+    bfdiag.reset_peer_health()
+    yield
+    chaos.uninstall()
+    rz.reset()
+    bfdiag.reset_peer_health()
+    bfm.stop_metrics()
+    bfm.reset_metrics()
+
+
+@pytest.fixture
+def ctx(cpu_devices):
+    bf.init(devices=cpu_devices)
+    bf.set_topology(tu.ExponentialTwoGraph(N), is_weighted=True)
+    yield
+    bf.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Healing: schedule / topology surgery (pure math, no mesh needed)
+# ---------------------------------------------------------------------------
+
+def test_schedule_weight_matrix_roundtrips_compiled_tables():
+    topo = tu.ExponentialTwoGraph(N)
+    sched = sch.compile_topology(topo, weighted=True)
+    W = rz.schedule_weight_matrix(sched)
+    np.testing.assert_allclose(W.sum(axis=0), np.ones(N), atol=1e-12)
+    np.testing.assert_allclose(W, tu.to_weight_matrix(topo), atol=1e-12)
+
+
+def test_heal_schedule_folds_dead_mass_into_self_loop():
+    sched = sch.compile_topology(tu.ExponentialTwoGraph(N), weighted=True)
+    healed = rz.heal_schedule(sched, [3])
+    W0 = rz.schedule_weight_matrix(sched)
+    W = rz.schedule_weight_matrix(healed)
+    # still column-stochastic; rank 3 is an isolated unit self-loop
+    np.testing.assert_allclose(W.sum(axis=0), np.ones(N), atol=1e-12)
+    assert W[3, 3] == 1.0
+    assert np.all(W[3, :3] == 0) and np.all(W[3, 4:] == 0)
+    assert np.all(W[:3, 3] == 0) and np.all(W[4:, 3] == 0)
+    # rank 3's former out-mass landed on each receiver's own diagonal
+    for dst in range(N):
+        if dst == 3:
+            continue
+        assert W[3, dst] == 0.0
+        np.testing.assert_allclose(W[dst, dst], W0[dst, dst] + W0[3, dst],
+                                   atol=1e-12)
+    # the compiled tables agree: no healed rank lists 3 as an in-neighbor
+    for dst in range(N):
+        if dst != 3:
+            assert 3 not in healed.in_neighbors[dst]
+    assert healed.in_neighbors[3] == ()
+
+
+def test_heal_schedule_sees_unweighted_effective_weights():
+    """For a topology used unweighted the *effective* mixing weight is
+    1/(in_degree+1); healing the compiled schedule (not the graph) folds
+    exactly that mass."""
+    sched = sch.compile_topology(tu.ExponentialTwoGraph(N), weighted=False)
+    np.testing.assert_allclose(sched.self_weight, np.full(N, 0.25))
+    healed = rz.heal_schedule(sched, [3])
+    W = rz.schedule_weight_matrix(healed)
+    np.testing.assert_allclose(W.sum(axis=0), np.ones(N), atol=1e-12)
+    # Exp2(8): rank 3 feeds dsts 4 (offset 1), 5 (offset 2), 7 (offset 4)
+    for dst, self_w in [(0, .25), (1, .25), (2, .25), (4, .5), (5, .5),
+                        (6, .25), (7, .5)]:
+        assert W[dst, dst] == pytest.approx(self_w), dst
+
+
+def test_heal_topology_matches_heal_schedule_for_weighted_graphs():
+    topo = tu.ExponentialTwoGraph(N)
+    healed_g = rz.heal_topology(topo, [2, 5])
+    W = tu.to_weight_matrix(healed_g)
+    Ws = rz.schedule_weight_matrix(
+        rz.heal_schedule(sch.compile_topology(topo, weighted=True), [2, 5]))
+    np.testing.assert_allclose(W, Ws, atol=1e-12)
+    np.testing.assert_allclose(W.sum(axis=0), np.ones(N), atol=1e-12)
+
+
+def test_heal_validates_dead_set():
+    sched = sch.compile_topology(tu.ExponentialTwoGraph(4), weighted=True)
+    with pytest.raises(ValueError, match="out of range"):
+        rz.heal_schedule(sched, [4])
+    with pytest.raises(ValueError, match="all 4 ranks"):
+        rz.heal_schedule(sched, [0, 1, 2, 3])
+
+
+def test_heal_dynamic_schedules():
+    topo = tu.ExponentialTwoGraph(N)
+    factory = lambda r: tu.GetDynamicOnePeerSendRecvRanks(topo, r)
+    scheds = sch.compile_dynamic_schedules(factory, N)
+    healed = rz.heal_dynamic_schedules(scheds, [1])
+    assert len(healed) == len(scheds)
+    for s in healed:
+        W = rz.schedule_weight_matrix(s)
+        np.testing.assert_allclose(W.sum(axis=0), np.ones(N), atol=1e-12)
+        assert W[1, 1] == 1.0
+        for dst in range(N):
+            if dst != 1:
+                assert 1 not in s.in_neighbors[dst]
+
+
+# ---------------------------------------------------------------------------
+# The dead-rank registry against a live context
+# ---------------------------------------------------------------------------
+
+def test_mark_rank_dead_heals_live_context(ctx):
+    before = bf.static_schedule()
+    assert 3 in before.in_neighbors[4]
+    assert rz.mark_rank_dead(3) == (3,)
+    assert rz.dead_ranks() == (3,)
+    after = bf.static_schedule()
+    assert after is not before
+    for dst in range(N):
+        if dst != 3:
+            assert 3 not in after.in_neighbors[dst]
+    # topology view stays consistent with the healed tables
+    assert 3 not in bf.in_neighbor_ranks(4)
+    assert bfm.gauge("bluefog_dead_ranks").value() == 1.0
+    # idempotent; accumulates
+    assert rz.mark_rank_dead(3) == (3,)
+    assert rz.mark_rank_dead(6) == (3, 6)
+    assert bfm.gauge("bluefog_dead_ranks").value() == 2.0
+    assert bfdiag.peer_health()["failed"] == (3, 6)
+    rz.reset()
+    assert rz.dead_ranks() == ()
+    assert bfm.gauge("bluefog_dead_ranks").value() == 0.0
+
+
+def test_mark_rank_dead_heals_dynamic_schedules(ctx):
+    topo = tu.ExponentialTwoGraph(N)
+    bf.set_dynamic_topology(lambda r: tu.GetDynamicOnePeerSendRecvRanks(topo, r))
+    rz.mark_rank_dead(2)
+    for s in bf.dynamic_schedules():
+        for dst in range(N):
+            if dst != 2:
+                assert 2 not in s.in_neighbors[dst]
+
+
+# ---------------------------------------------------------------------------
+# check_finite + peer health
+# ---------------------------------------------------------------------------
+
+def test_check_finite_flags_per_rank(ctx):
+    good = np.ones((N, D), np.float32)
+    bad = good.copy()
+    bad[2] = np.nan
+    tree = {"a": bf.shard_distributed(jnp.asarray(bad)),
+            "b": bf.shard_distributed(jnp.asarray(good))}
+    finite = np.asarray(bf.check_finite(tree))
+    assert finite.shape == (N,) and finite.dtype == bool
+    assert not finite[2] and finite[np.arange(N) != 2].all()
+
+    bfdiag.observe_peer_finiteness(finite, step=1)
+    assert bfdiag.unhealthy_ranks() == (2,)
+    bfdiag.observe_peer_finiteness(finite, step=2)
+    assert bfdiag.unhealthy_ranks(streak=2) == (2,)
+    # a clean step clears the streak
+    bfdiag.observe_peer_finiteness(np.ones(N, bool), step=3)
+    assert bfdiag.unhealthy_ranks() == ()
+
+
+# ---------------------------------------------------------------------------
+# Training-loop helpers
+# ---------------------------------------------------------------------------
+
+def grad_fn(params, batch):
+    loss = jnp.mean((params["w"] - batch) ** 2)
+    return loss, jax.grad(lambda p: jnp.mean((p["w"] - batch) ** 2))(params)
+
+
+def _gossip_setup(params=None):
+    """lr=0 strategy on the CURRENT (possibly healed) static schedule:
+    params evolve only by mixing."""
+    strat = bfopt.adapt_with_combine(
+        optax.sgd(0.0), bfopt.neighbor_communicator(bf.static_schedule()))
+    if params is None:
+        params = {"w": jnp.broadcast_to(
+            jnp.arange(float(N))[:, None], (N, D)).astype(jnp.float32)}
+    state = bfopt.init_distributed(strat, params)
+    step = bfopt.make_train_step(grad_fn, strat)
+    return step, params, state, jnp.zeros((N, D), jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# Acceptance (a): kill a rank mid-run, heal, keep contracting
+# ---------------------------------------------------------------------------
+
+def test_rank_kill_heal_and_monotone_contraction(ctx):
+    chaos.install("seed=42;kill:step=4,rank=3")
+    step, params, state, batch = _gossip_setup()
+    for _ in range(3):
+        params, state, loss = step(params, state, batch)
+    with pytest.raises(chaos.RankKilled) as ei:
+        step(params, state, batch)
+    assert ei.value.rank == 3
+    chaos.uninstall()                  # the rank is dead; stop re-killing
+
+    # heal: survivors exclude rank 3, its mass folds into self-loops
+    assert rz.mark_rank_dead(ei.value.rank) == (3,)
+    step, params, state, batch = _gossip_setup(params)
+
+    dist = [bfdiag.diagnose_consensus(
+        params, dead_ranks=(3,))["consensus_distance_max"]]
+    w1 = None
+    for i in range(10):
+        params, state, loss = step(params, state, batch)
+        jax.block_until_ready(loss)
+        dist.append(bfdiag.diagnose_consensus(
+            params, dead_ranks=(3,))["consensus_distance_max"])
+        if i == 0:
+            w1 = params["w"]
+    # consensus of the 7 SURVIVORS contracts monotonically to ~0 even
+    # though the healed matrix is only column-stochastic
+    assert all(b <= a + 1e-6 for a, b in zip(dist, dist[1:])), dist
+    assert dist[-1] < 0.05 * dist[0], dist
+    # rank 3 is frozen at its pre-kill value, not mixed back in
+    w = np.asarray(jax.device_get(params["w"]))
+    assert np.isfinite(w).all()
+    # the step path stayed healthy through the heal
+    assert w1.is_deleted()                     # donation intact
+    assert bfm.counter("bluefog_retrace_after_warmup_total").total() == 0
+    assert bfm.counter("bluefog_faults_injected_total").value(kind="kill") == 1
+    assert bfm.gauge("bluefog_dead_ranks").value() == 1.0
+    assert bfm.metrics_summary()["resilience"]["dead_ranks"] == 1.0
+
+
+# ---------------------------------------------------------------------------
+# Acceptance (b): NaN injection -> step skipped, rollback to last good
+# ---------------------------------------------------------------------------
+
+def test_nan_step_skipped_and_rolled_back(ctx):
+    chaos.install("nan:step=3,rank=2")
+    step, params, state, batch = _gossip_setup()
+    guard = bf.guard_step(step, depth=2)
+
+    params, state, loss = guard(params, state, batch)
+    params, state, loss = guard(params, state, batch)
+    w_good = np.asarray(jax.device_get(params["w"]))   # last-good, call 2
+
+    params, state, loss = guard(params, state, batch)  # poisoned -> rollback
+    assert guard.nonfinite_steps == 1 and guard.rollbacks == 1
+    np.testing.assert_array_equal(np.asarray(jax.device_get(params["w"])),
+                                  w_good)
+    assert bfm.counter("bluefog_nonfinite_steps_total").total() == 1
+    assert bfdiag.peer_health()["nonfinite_streak"].get(2, 0) >= 1
+
+    params, state, loss = guard(params, state, batch)  # clean continue
+    assert guard.calls == 4 and guard.nonfinite_steps == 1
+    assert np.isfinite(np.asarray(jax.device_get(params["w"]))).all()
+    assert bfm.counter("bluefog_retrace_after_warmup_total").total() == 0
+    assert bfm.metrics_summary()["resilience"]["nonfinite_steps"] == 1.0
+
+
+def test_guard_without_snapshot_raises(ctx):
+    chaos.install("nan:step=1,rank=0")
+    step, params, state, batch = _gossip_setup()
+    guard = bf.guard_step(step)
+    with pytest.raises(FloatingPointError, match="ranks \\[0\\]"):
+        guard(params, state, batch)
+
+
+def test_guard_check_every_k_and_dead_mask(ctx):
+    """Non-finite output on a rank already marked dead is NOT a fault —
+    a healed-around rank's frozen shard may be anything."""
+    chaos.install("nan:step=2,rank=5")
+    rz.mark_rank_dead(5)
+    step, params, state, batch = _gossip_setup()
+    guard = bf.guard_step(step, check_every_k=2)
+    params, state, loss = guard(params, state, batch)   # unchecked (call 1)
+    params, state, loss = guard(params, state, batch)   # checked: 5 is dead
+    assert guard.nonfinite_steps == 0 and guard.rollbacks == 0
+    assert bfm.counter("bluefog_nonfinite_steps_total").total() == 0

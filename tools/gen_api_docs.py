@@ -44,8 +44,10 @@ MODULES = [
     ("bluefog_tpu.utils.config", "Environment configuration"),
     ("bluefog_tpu.utils.timeline", "Timeline tracing"),
     ("bluefog_tpu.utils.metrics", "Live metrics registry + exporters"),
-    ("bluefog_tpu.diagnostics", "Consensus-health probes"),
+    ("bluefog_tpu.diagnostics", "Consensus-health probes + peer health"),
     ("bluefog_tpu.utils.watchdog", "Stall watchdog"),
+    ("bluefog_tpu.resilience", "Fault tolerance (healing + rollback)"),
+    ("bluefog_tpu.utils.chaos", "Deterministic fault injection"),
 ]
 
 

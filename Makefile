@@ -6,7 +6,7 @@ PY ?= python
 
 .PHONY: test test-fast test_basic test_ops test_win_ops test_optimizer \
 	test_hier test_native test_examples verify native clean hw-watch \
-	obs-smoke chaos-smoke
+	obs-smoke chaos-smoke overlap-smoke
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -89,6 +89,23 @@ obs-smoke:
 		assert r['ok'] and r['n_hosts'] == 2 and all(k in r for k in \
 		('metrics', 'series', 'summary')), r; \
 		print('obs-smoke OK')"
+
+# pipelined-gossip smoke: the CPU-feasible overlap battery (delayed-CTA
+# trajectory/HLO/contract tests, round-parallel equivalence) plus a schema
+# check of trace_analyze's per-op exposed-time attribution on the committed
+# overlapped-step fixture — the same tests run in tier-1 (none are `slow`)
+overlap-smoke:
+	$(PY) -m pytest tests/test_overlap.py -q
+	$(PY) tools/trace_analyze.py tests/fixtures/overlap_trace.trace.json \
+		--out /tmp/overlap_trace_split.json
+	$(PY) -c "import json; \
+		d = json.load(open('/tmp/overlap_trace_split.json')); \
+		assert d['ok'] and all(k in d for k in ('comm_exposed_ms', \
+		'overlap_fraction', 'top_exposed_comm_ops')), d; \
+		rows = d['top_exposed_comm_ops']; \
+		assert rows and all(set(r) == {'name', 'count', 'total_ms', \
+		'exposed_ms'} for r in rows), rows; \
+		print('overlap-smoke OK')"
 
 # resilience smoke: deterministic fault injection + healing/rollback on
 # the virtual CPU mesh (kill->heal->contract, NaN->rollback, restart

@@ -23,6 +23,10 @@ env-tunable:
   BLUEFOG_BENCH_FORCE_CPU=1      skip probing, run the CPU fallback
   BLUEFOG_BENCH_BATCH / _ITERS / _STEPS_PER_CALL   workload overrides
   BLUEFOG_BENCH_IMAGE_SIZE / _CLASSES   shrink the model for CI smoke tests
+  BLUEFOG_BENCH_OVERLAP=1 (or --overlap)   also measure sequential vs
+    pipelined (delayed=True + overlap=True) steps under a profiler trace;
+    the artifact gains an "overlap" object with per-mode per_step_s,
+    overlap_fraction, comm_exposed_s, top_exposed_comm_ops, and deltas
 
 Probe outcomes are remembered in ``.probe_state.json`` (written here and by
 tools/hw_watch.py): when the last probe FAILED within
@@ -562,6 +566,25 @@ def run_bench(on_accelerator: bool, probe_info: dict) -> dict:
             "fused_speedup": round(spc1_per_step_s / fused_per_step_s, 4),
         }
 
+    # pipelined-vs-sequential gossip comparison (--overlap /
+    # BLUEFOG_BENCH_OVERLAP=1): measure the SAME workload with the
+    # one-step-delayed communicator (adapt_with_combine(delayed=True) +
+    # overlap=True) and with the bulk-sequential strategy, capture a
+    # profiler trace of each, and attribute comm exposure via
+    # tools/trace_analyze — the artifact then carries the overlap proof
+    # (overlap_fraction / comm_exposed_s / fused_per_step_s deltas), not
+    # just a throughput number.  Fully guarded: a profiler or analyzer
+    # failure downgrades to timings-only, never kills the measurement.
+    overlap_report = None
+    if "--overlap" in sys.argv or os.environ.get("BLUEFOG_BENCH_OVERLAP") == "1":
+        try:
+            overlap_report = _overlap_compare(
+                bf, bfopt, grad_fn, opt, train_state, n, data,
+                steps_per_call, iters)
+        except Exception as e:            # pragma: no cover - belt+braces
+            overlap_report = {"ok": False,
+                              "error": f"{type(e).__name__}: {e}"[:300]}
+
     device_kind = jax.devices()[0].device_kind
     peak_spec = _peak_flops(device_kind) if on_accelerator else None
     # a trusted roofline measurement (tools/roofline.py) beats the spec
@@ -631,6 +654,7 @@ def run_bench(on_accelerator: bool, probe_info: dict) -> dict:
         "donated": True,              # params/opt-state donated in the step
         "fused_per_step_s": round(fused_per_step_s, 6),
         "fused_vs_spc1": fused_vs_spc1,
+        "overlap": overlap_report,
         "image_size": image_size,
         "num_classes": num_classes,
         "config_source": config_source,
@@ -640,6 +664,89 @@ def run_bench(on_accelerator: bool, probe_info: dict) -> dict:
         "metrics_summary": metrics_summary,
         **probe_info,
     }
+
+
+def _trace_overlap_stats(trace_dir):
+    """Run tools/trace_analyze on a fresh profiler trace dir, in-process.
+    Returns the analysis doc or None (missing trace, parse failure)."""
+    try:
+        tools_dir = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "tools")
+        if tools_dir not in sys.path:
+            sys.path.insert(0, tools_dir)
+        import trace_analyze as ta
+        doc = ta.analyze(ta.load_events(ta.find_trace_file(trace_dir)))
+        return doc if doc.get("ok") else None
+    except Exception:
+        return None
+
+
+def _overlap_compare(bf, bfopt, grad_fn, opt, train_state, n, data,
+                     steps_per_call, iters):
+    """Measure sequential vs pipelined (one-step-delayed) gossip.
+
+    Both variants run the identical fused workload; the pipelined one uses
+    ``adapt_with_combine(..., delayed=True)`` + ``overlap=True`` so the
+    permute chain is data-independent of the update and the scheduler can
+    hide it.  Each variant is profiled and fed through trace_analyze for
+    ``overlap_fraction`` / ``comm_exposed_s``; deltas summarize the win.
+    """
+    import shutil
+    import tempfile
+
+    import jax
+
+    def measure(delayed):
+        comm = bfopt.neighbor_communicator(bf.static_schedule())
+        strat = bfopt.adapt_with_combine(opt, comm, delayed=delayed)
+        p = bfopt.replicate(train_state, n)
+        s = bfopt.init_distributed(strat, p)
+        step = bfopt.make_train_step(
+            grad_fn, strat, steps_per_call=steps_per_call,
+            reuse_batch=steps_per_call > 1, donate=True, overlap=delayed)
+        p, s, loss = step(p, s, data)            # warmup/compile untraced
+        bf.hard_sync(loss)
+        trace_dir = tempfile.mkdtemp(prefix="bf-bench-overlap-")
+        t0 = time.perf_counter()
+        try:
+            with jax.profiler.trace(trace_dir):
+                for _ in range(iters):
+                    p, s, loss = step(p, s, data)
+                bf.hard_sync(loss)
+        except Exception:
+            # profiler unavailable (some backends): retime untraced
+            for _ in range(iters):
+                p, s, loss = step(p, s, data)
+            bf.hard_sync(loss)
+        dt = time.perf_counter() - t0
+        stats = _trace_overlap_stats(trace_dir)
+        shutil.rmtree(trace_dir, ignore_errors=True)
+        row = {"per_step_s": round(dt / (iters * steps_per_call), 6)}
+        if stats is not None:
+            row["overlap_fraction"] = stats.get("overlap_fraction")
+            row["comm_exposed_s"] = round(
+                stats.get("comm_exposed_ms", 0.0) / 1e3, 6)
+            row["comm_s"] = round(stats.get("comm_ms", 0.0) / 1e3, 6)
+            row["top_exposed_comm_ops"] = stats.get(
+                "top_exposed_comm_ops", [])[:3]
+        return row
+
+    seq = measure(delayed=False)
+    pipe = measure(delayed=True)
+    deltas = {
+        "per_step_speedup": round(
+            seq["per_step_s"] / pipe["per_step_s"], 4)
+        if pipe["per_step_s"] else None,
+    }
+    if "comm_exposed_s" in seq and "comm_exposed_s" in pipe:
+        deltas["comm_exposed_s_delta"] = round(
+            seq["comm_exposed_s"] - pipe["comm_exposed_s"], 6)
+    if (seq.get("overlap_fraction") is not None
+            and pipe.get("overlap_fraction") is not None):
+        deltas["overlap_fraction_delta"] = round(
+            pipe["overlap_fraction"] - seq["overlap_fraction"], 4)
+    return {"ok": True, "iters": iters, "steps_per_call": steps_per_call,
+            "sequential": seq, "pipelined": pipe, "deltas": deltas}
 
 
 def _cpu_fallback_subprocess(probe_info: dict, reason: str,

@@ -92,7 +92,17 @@ def shard_distributed(x: jax.Array) -> jax.Array:
     """Place a distributed tensor on the mesh, sharded along the rank axis."""
     ctx = _mesh.get_context()
     _check_distributed(x, ctx.size)
-    return jax.device_put(x, NamedSharding(ctx.mesh, P("rank")))
+    sharding = NamedSharding(ctx.mesh, P("rank"))
+    if jax.process_count() > 1:
+        # device_put of a host-local array onto a cross-process sharding
+        # routes through multihost_utils.assert_equal — a *computation* on
+        # the global mesh, which some backends (CPU tests; heterogeneous
+        # bring-up) cannot run outside shard_map.  Assembling from per-shard
+        # callbacks places each addressable shard directly, no collective.
+        arr = np.asarray(x)
+        return jax.make_array_from_callback(
+            arr.shape, sharding, lambda idx: arr[idx])
+    return jax.device_put(x, sharding)
 
 
 # ---------------------------------------------------------------------------
@@ -170,6 +180,7 @@ def neighbor_allreduce(
     step: Optional[int] = None,
     wire: Optional[str] = None,
     donate: bool = False,
+    concurrent: Optional[bool] = None,
 ) -> jax.Array:
     """Weighted neighbor averaging of each rank's slice (the flagship op).
 
@@ -184,6 +195,11 @@ def neighbor_allreduce(
     of allocating a fresh result).  Opt-in because it invalidates the
     caller's ``x`` — the right mode on step paths that rebind, e.g.
     ``x = bf.neighbor_allreduce(x, donate=True)``.
+
+    ``concurrent=True`` emits the edge-colored gossip rounds as one
+    concurrent permute group instead of a sequential chain (default: the
+    context knob ``bf.set_round_parallel`` / ``BLUEFOG_ROUND_PARALLEL``,
+    see :func:`bluefog_tpu.ops.neighbor_allreduce`).
     """
     ctx = _mesh.get_context()
     _check_distributed(x, ctx.size)
@@ -196,11 +212,17 @@ def neighbor_allreduce(
                 "counter) so the period's schedule can be selected")
         schedule = dyn[int(step) % len(dyn)]
     sched = resolve_schedule(self_weight, src_weights, dst_weights, schedule)
+    # resolve the round-parallel default NOW so it is part of the cache key
+    # — otherwise a program traced under one knob setting would be served
+    # after the knob flips
+    if concurrent is None:
+        concurrent = ops.collectives._default_concurrent()
     fn = _cached(
-        ("nar", sched, ctx.mesh, x.shape, x.dtype.name, wire, donate),
+        ("nar", sched, ctx.mesh, x.shape, x.dtype.name, wire, donate,
+         concurrent),
         lambda: _shard_map_1d(
             _per_rank(partial(ops.neighbor_allreduce, sched=sched,
-                              axis="rank", wire=wire)),
+                              axis="rank", wire=wire, concurrent=concurrent)),
             ctx.mesh, donate=donate))
     return _dispatch("neighbor_allreduce", fn, x)
 

@@ -47,8 +47,11 @@ class FusedTree:
             for i in idxs:
                 shape = self._shapes[i]
                 n = int(np.prod(shape)) if shape else 1
-                leaves[i] = jax.lax.dynamic_slice_in_dim(
-                    buf, off, n, axis=0).reshape(shape)
+                # offsets are Python ints known at trace time: a static
+                # lax.slice folds into the surrounding program, where a
+                # dynamic-slice would survive into the step HLO as a real op
+                leaves[i] = jax.lax.slice_in_dim(
+                    buf, off, off + n, axis=0).reshape(shape)
                 off += n
         return jax.tree.unflatten(self._treedef, leaves)
 

@@ -175,12 +175,88 @@ def _wire_ppermute(wire: Optional[str], send: jax.Array, axis: Axis,
     return _wire_decode(wire, moved, send.dtype, shape=send.shape)
 
 
+def _default_concurrent() -> bool:
+    """Round-parallel default: live-context knob, else BLUEFOG_ROUND_PARALLEL.
+
+    Lazy imports keep ops importable without the context/config layers
+    (the AOT tests build schedules with no live mesh).
+    """
+    try:
+        from ..parallel import context as _ctx
+        c = _ctx._context
+        if c is not None and c.round_parallel is not None:
+            return bool(c.round_parallel)
+    except Exception:
+        pass
+    try:
+        from ..utils.config import env_flag
+        return env_flag("BLUEFOG_ROUND_PARALLEL", False)
+    except Exception:
+        return False
+
+
+def _round_sends(x: jax.Array, sched: CommSchedule, idx) -> list:
+    """Per-round send values (dst-weighting applies the sender-side scale)."""
+    sends = []
+    for r in range(sched.num_rounds):
+        send = x
+        if sched.uses_dst_weighting:
+            # dst-weighting: the *sender* scales per-edge before the permute
+            # (reference fusion-buffer trick, mpi_controller.cc:1394-1454).
+            send = x * _table(sched.send_scale[r], idx, x.dtype)
+        sends.append(send)
+    return sends
+
+
+def _concurrent_ppermutes(wire: Optional[str], sends, axis: Axis,
+                          rounds) -> list:
+    """Issue every round's permute as one concurrent group.
+
+    The sequential path interleaves ``acc = acc + recv * w`` between
+    permutes, handing the scheduler a chain it tends to respect; here all
+    sends are materialized first, every permute is issued back-to-back with
+    no arithmetic between them, and only then are the results combined —
+    the permute group's depth is the chromatic index of the topology, not
+    ``num_rounds`` sequential hops.  The barriers serve double duty: they
+    pin the wire codecs exactly like :func:`_wire_ppermute` (encode/decode
+    must not commute across the permutes) and they fence the group so the
+    combine arithmetic cannot be threaded between rounds.
+    """
+    if wire is None:
+        sends = lax.optimization_barrier(tuple(sends))
+        recvs = lax.optimization_barrier(tuple(
+            lax.ppermute(s, axis, perm=perm)
+            for s, perm in zip(sends, rounds)))
+        return list(recvs)
+    for s in sends:
+        if not jnp.issubdtype(s.dtype, jnp.floating):
+            raise ValueError(
+                f"wire compression needs a real float input, got {s.dtype}")
+    encoded = [_wire_encode(wire, s) for s in sends]
+    widths = [len(parts) for parts in encoded]
+    flat = lax.optimization_barrier(
+        tuple(p for parts in encoded for p in parts))
+    moved, pos = [], 0
+    for w, perm in zip(widths, rounds):
+        moved.extend(lax.ppermute(flat[pos + i], axis, perm=perm)
+                     for i in range(w))
+        pos += w
+    moved = lax.optimization_barrier(tuple(moved))
+    recvs, pos = [], 0
+    for w, s in zip(widths, sends):
+        recvs.append(_wire_decode(wire, tuple(moved[pos:pos + w]),
+                                  s.dtype, shape=s.shape))
+        pos += w
+    return recvs
+
+
 def neighbor_allreduce(
     x: jax.Array,
     sched: CommSchedule,
     *,
     axis: Axis = "rank",
     wire: Optional[str] = None,
+    concurrent: Optional[bool] = None,
 ) -> jax.Array:
     """Weighted average of ``x`` with in-neighbor values under ``sched``.
 
@@ -196,14 +272,30 @@ def neighbor_allreduce(
     regimes (small batch, DCN cross-machine edges).  The self term always combines at full precision;
     gossip averaging tolerates the bounded quantization error the way
     consensus tolerates stale neighbor values.
+
+    ``concurrent=True`` emits the edge-colored rounds as one concurrent
+    permute group instead of a sequential permute/combine chain — every
+    round's input is ``x`` (rounds are edge-disjoint by construction,
+    :func:`bluefog_tpu.schedule.rounds_edge_disjoint`), so the chain depth
+    was never semantically required.  The weighted combine happens after
+    the whole group, in round order, so results match the sequential path
+    exactly up to float summation.  ``None`` (default) resolves to the
+    context's ``round_parallel`` knob, then ``BLUEFOG_ROUND_PARALLEL``,
+    then False.
     """
+    if concurrent is None:
+        concurrent = _default_concurrent()
     idx = lax.axis_index(axis)
     acc = x * _table(sched.self_weight, idx, x.dtype)
+    if concurrent and sched.num_rounds > 1:
+        sends = _round_sends(x, sched, idx)
+        recvs = _concurrent_ppermutes(wire, sends, axis, sched.rounds)
+        for r, recv in enumerate(recvs):
+            acc = acc + recv * _table(sched.recv_weight[r], idx, x.dtype)
+        return acc
     for r in range(sched.num_rounds):
         send = x
         if sched.uses_dst_weighting:
-            # dst-weighting: the *sender* scales per-edge before the permute
-            # (reference fusion-buffer trick, mpi_controller.cc:1394-1454).
             send = x * _table(sched.send_scale[r], idx, x.dtype)
         recv = _wire_ppermute(wire, send, axis, sched.rounds[r])
         acc = acc + recv * _table(sched.recv_weight[r], idx, x.dtype)

@@ -67,6 +67,7 @@ def neighbor_communicator(
     axis: Axis = "rank",
     fuse: bool = True,
     wire: Optional[str] = None,
+    concurrent: Optional[bool] = None,
 ) -> Communicator:
     """Neighbor averaging of a params pytree; dynamic when ``schedules``.
 
@@ -78,7 +79,9 @@ def neighbor_communicator(
     the gossiped bytes on the wire (``"bf16"``/``"int8"``/``"fp8"``, see
     :func:`bluefog_tpu.ops.neighbor_allreduce`); with ``fuse`` the int8/fp8
     riding scale is per flat buffer, amortizing the side channel across the
-    whole model.
+    whole model.  ``concurrent`` forwards to
+    :func:`bluefog_tpu.ops.neighbor_allreduce` (round-parallel emission of
+    the edge-colored permute rounds; None = context/env default).
     """
     if (schedule is None) == (schedules is None):
         raise ValueError("pass exactly one of schedule / schedules")
@@ -92,9 +95,11 @@ def neighbor_communicator(
             # uncompressed — quantizing them is meaningless or lossy
             w = wire if jnp.issubdtype(x.dtype, jnp.floating) else None
             if schedule is not None:
-                return ops.neighbor_allreduce(x, schedule, axis=axis, wire=w)
+                return ops.neighbor_allreduce(x, schedule, axis=axis, wire=w,
+                                              concurrent=concurrent)
             branches = [
-                partial(ops.neighbor_allreduce, sched=s, axis=axis, wire=w)
+                partial(ops.neighbor_allreduce, sched=s, axis=axis, wire=w,
+                        concurrent=concurrent)
                 for s in schedules
             ]
             return lax.switch(step % len(schedules), branches, x)
@@ -190,6 +195,13 @@ class DecentralizedOptimizer(NamedTuple):
     init: Callable[[Any], DecentralizedState]
     update: Callable[[Any, DecentralizedState, Any], Tuple[Any, DecentralizedState]]
     axes: Tuple[str, ...] = ("rank",)
+    # True for strategies whose comm_state carries in-flight (one-step-
+    # delayed) mixed parameters: the gossip issued at step t is consumed by
+    # the adapt of step t+1, so XLA's latency-hiding scheduler can run the
+    # permute chain concurrently with the step's matmuls.  ``make_train_step
+    # (overlap=True)`` requires it, and ``init_distributed`` seeds the carry
+    # from each rank's OWN params instead of the broadcast template.
+    pipelined: bool = False
 
 
 def _apply(opt, grads, opt_state, params):
@@ -239,6 +251,7 @@ def adapt_with_combine(
     comm: Communicator,
     *,
     num_steps_per_communication: int = 1,
+    delayed: bool = False,
     axes: Tuple[str, ...] = ("rank",),
 ) -> DecentralizedOptimizer:
     """Combine-then-adapt (CTA): x_{t+1} = A(Comb(x_t), g_t).
@@ -250,18 +263,48 @@ def adapt_with_combine(
     is intentionally "stale" w.r.t. the combined point; that is the CTA
     algorithm, and XLA overlaps the gossip with the backward compute here for
     the same latency hiding.
+
+    ``delayed=True`` is the pipelined (one-step-stale) variant:
+
+        x_{t+1} = A(Comb(x_{t-1}), g(x_t))
+
+    The gossip issued at step t rides in ``comm_state`` and is consumed by
+    step t+1's adapt, so the adapt never waits on the permute chain — inside
+    a fused ``lax.scan`` the in-flight mixed params live in the scan carry
+    and the permutes of step t overlap the matmuls of step t (AD-PSGD /
+    D-PSGD staleness analysis: 1-step-stale mixing preserves the convergence
+    rate).  The first step adapts on the rank's own params (carry seeded by
+    ``init``/``init_distributed``); staleness begins at step 2.  Pair with
+    ``make_train_step(..., overlap=True)``.
     """
+    if delayed and num_steps_per_communication != 1:
+        raise ValueError(
+            "delayed=True requires num_steps_per_communication == 1: the "
+            "carried mixed params would be poisoned by raw params on "
+            "non-communicating steps")
     comm = _every_k(comm, num_steps_per_communication)
 
     def init(params):
-        return DecentralizedState(jnp.zeros((), jnp.int32), opt.init(params))
+        carry = jax.tree.map(jnp.copy, params) if delayed else None
+        return DecentralizedState(
+            jnp.zeros((), jnp.int32), opt.init(params), carry)
 
     def update(grads, state, params):
+        if delayed:
+            # issue gossip on the CURRENT params; adapt on LAST step's
+            # result — the permutes' inputs never pass through this step's
+            # update dot-generals, which is what lets the latency-hiding
+            # scheduler bury them under compute.
+            mixed_next = comm(params, state.step)
+            new_params, opt_state = _apply(
+                opt, grads, state.opt_state, state.comm_state)
+            return new_params, DecentralizedState(
+                state.step + 1, opt_state, mixed_next)
         combined = comm(params, state.step)
         new_params, opt_state = _apply(opt, grads, state.opt_state, combined)
         return new_params, DecentralizedState(state.step + 1, opt_state)
 
-    return DecentralizedOptimizer(init, update, axes)
+    return DecentralizedOptimizer(init, update, axes, pipelined=delayed)
 
 
 def adapt_then_combine(
@@ -269,6 +312,7 @@ def adapt_then_combine(
     comm: Communicator,
     *,
     num_steps_per_communication: int = 1,
+    delayed: bool = False,
     axes: Tuple[str, ...] = ("rank",),
 ) -> DecentralizedOptimizer:
     """Adapt-then-combine (ATC): x_{t+1} = Comb(A(x_t, g_t)).
@@ -276,7 +320,17 @@ def adapt_then_combine(
     Reference: ``DistributedAdaptThenCombineOptimizer``
     (``optimizers.py:484-760``) — backward hooks run the optimizer step inline
     per parameter, then immediately fire communication of the adapted value.
+    The permute chain here is data-dependent on the update by construction
+    (it mixes the adapted value), which is why the pipelined mode lives on
+    CTA: delaying ATC's gossip by one step turns it into delayed CTA anyway
+    (the gossip always sees pre-update params), so ``delayed=True`` is
+    rejected with a pointer instead of silently changing algorithms.
     """
+    if delayed:
+        raise ValueError(
+            "adapt_then_combine cannot be pipelined: its gossip input IS "
+            "the update output. Use adapt_with_combine(..., delayed=True) "
+            "for one-step-delayed mixing")
     comm = _every_k(comm, num_steps_per_communication)
 
     def init(params):
@@ -1183,7 +1237,7 @@ def _comm_from_type(communication_type: str, kw):
                 else empty_communicator())
     else:
         raise ValueError(f"unknown communication_type {communication_type!r}")
-    allowed = ("num_steps_per_communication", "axes")
+    allowed = ("num_steps_per_communication", "axes", "delayed")
     unknown = set(kw) - set(allowed)
     if unknown:
         raise TypeError(f"unexpected arguments: {sorted(unknown)}")
@@ -1205,7 +1259,15 @@ def init_distributed(strategy: DecentralizedOptimizer, dist_params):
     template = jax.tree.map(lambda x: x[0], dist_params)
     state = strategy.init(template)
     n = jax.tree.leaves(dist_params)[0].shape[0]
-    return jax.tree.map(lambda x: jnp.broadcast_to(x[None], (n,) + x.shape), state)
+    state = jax.tree.map(
+        lambda x: jnp.broadcast_to(x[None], (n,) + x.shape), state)
+    if strategy.pipelined:
+        # the delayed-mixing carry must start from each rank's OWN params
+        # (broadcasting the rank-0 template would silently teleport rank 0's
+        # params into every rank's first adapt under rank-varying inits)
+        state = state._replace(
+            comm_state=jax.tree.map(jnp.copy, dist_params))
+    return state
 
 
 # Argument positions make_train_step donates (params, opt-state).  bench and
@@ -1231,10 +1293,12 @@ class _InstrumentedStep:
     """
 
     def __init__(self, fn, *, steps_per_call: int, donated: bool,
+                 overlap: bool = False,
                  metrics_every_k: Optional[int] = None, warmup: int = 2):
         self._fn = fn
         self._steps_per_call = steps_per_call
         self._donated = donated
+        self._overlap = overlap
         self._metrics_every_k = metrics_every_k
         self._warmup = max(int(warmup), 1)
         self._calls = 0
@@ -1269,7 +1333,8 @@ class _InstrumentedStep:
             out = _chaos.corrupt_train_output(out, self._calls)
         _metrics.record_step(dt, steps=self._steps_per_call,
                              donated=self._donated,
-                             fused_k=self._steps_per_call)
+                             fused_k=self._steps_per_call,
+                             overlap=self._overlap)
         k = self._metrics_every_k
         if k and (self._calls == 1 or self._calls % k == 0):
             from . import diagnostics as _diag
@@ -1299,6 +1364,17 @@ def _check_metrics_every_k(metrics_every_k, strategy):
             "manually for hierarchical strategies")
 
 
+def _check_overlap(overlap, strategy):
+    if overlap and not strategy.pipelined:
+        raise ValueError(
+            "overlap=True requires a pipelined strategy whose comm_state "
+            "carries one-step-delayed mixed params — build one with "
+            "adapt_with_combine(..., delayed=True) (or "
+            "DistributedAdaptWithCombineOptimizer(..., delayed=True)). "
+            "With a bulk-synchronous strategy the adapt waits on the "
+            "gossip, so there is nothing for the scheduler to overlap.")
+
+
 def make_train_step(
     grad_fn: Callable[[Any, Any], Tuple[jax.Array, Any]],
     strategy: DecentralizedOptimizer,
@@ -1306,6 +1382,7 @@ def make_train_step(
     steps_per_call: int = 1,
     reuse_batch: bool = False,
     donate: bool = True,
+    overlap: bool = False,
     metrics_every_k: Optional[int] = None,
     metrics_warmup: int = 2,
 ):
@@ -1345,8 +1422,18 @@ def make_train_step(
     steady state sees zero extra compilations.  ``metrics_warmup`` is the
     call count after which the retrace sentinel arms (every builder call
     always feeds step-time/flag metrics; the registry is cheap).
+
+    ``overlap=True`` declares the pipelined execution mode: it requires a
+    strategy built with ``delayed=True`` (``strategy.pipelined``), whose
+    in-flight mixed params ride the donated state carry — through the fused
+    ``lax.scan`` as well — so each step's permute chain is data-independent
+    of its update dot-generals and the latency-hiding scheduler can bury
+    the gossip under compute.  The flag is surfaced in the metrics registry
+    (``bluefog_step_overlap``) and validated here rather than inferred, so
+    a bulk-synchronous strategy silently losing the overlap is impossible.
     """
     _check_metrics_every_k(metrics_every_k, strategy)
+    _check_overlap(overlap, strategy)
     ctx = _mesh.get_context()
     mesh = ctx.mesh if strategy.axes == ("rank",) else ctx.mesh_2d
     spec = P("rank") if strategy.axes == ("rank",) else P(("machine", "local"))
@@ -1370,7 +1457,7 @@ def make_train_step(
                       out_specs=(spec, spec, spec)),
         donate_argnums=TRAIN_STEP_DONATE_ARGNUMS if donate else ())
     return _InstrumentedStep(
-        step, steps_per_call=steps_per_call, donated=donate,
+        step, steps_per_call=steps_per_call, donated=donate, overlap=overlap,
         metrics_every_k=metrics_every_k, warmup=metrics_warmup)
 
 
@@ -1421,6 +1508,7 @@ def make_stateful_train_step(
     steps_per_call: int = 1,
     reuse_batch: bool = False,
     donate: bool = True,
+    overlap: bool = False,
     state_sync: Optional[str] = None,
     state_sync_schedule: Optional[CommSchedule] = None,
     metrics_every_k: Optional[int] = None,
@@ -1442,12 +1530,13 @@ def make_stateful_train_step(
     Integer leaves (counters) are never averaged.  Syncing requires a
     rank-axis strategy (1-D mesh).
 
-    ``steps_per_call``, ``reuse_batch``, ``donate``, ``metrics_every_k``,
-    and ``metrics_warmup`` behave exactly as in :func:`make_train_step`
-    (donation here covers params, net state, and optimizer state —
-    :data:`STATEFUL_TRAIN_STEP_DONATE_ARGNUMS`).
+    ``steps_per_call``, ``reuse_batch``, ``donate``, ``overlap``,
+    ``metrics_every_k``, and ``metrics_warmup`` behave exactly as in
+    :func:`make_train_step` (donation here covers params, net state, and
+    optimizer state — :data:`STATEFUL_TRAIN_STEP_DONATE_ARGNUMS`).
     """
     _check_metrics_every_k(metrics_every_k, strategy)
+    _check_overlap(overlap, strategy)
     ctx = _mesh.get_context()
     mesh = ctx.mesh if strategy.axes == ("rank",) else ctx.mesh_2d
     spec = P("rank") if strategy.axes == ("rank",) else P(("machine", "local"))
@@ -1485,5 +1574,5 @@ def make_stateful_train_step(
                       out_specs=(spec,) * 4),
         donate_argnums=STATEFUL_TRAIN_STEP_DONATE_ARGNUMS if donate else ())
     return _InstrumentedStep(
-        step, steps_per_call=steps_per_call, donated=donate,
+        step, steps_per_call=steps_per_call, donated=donate, overlap=overlap,
         metrics_every_k=metrics_every_k, warmup=metrics_warmup)

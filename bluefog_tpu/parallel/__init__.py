@@ -29,6 +29,8 @@ from .context import (
     set_dynamic_topology,
     clear_dynamic_topology,
     dynamic_schedules,
+    set_round_parallel,
+    round_parallel,
 )
 
 __all__ = [
@@ -42,6 +44,7 @@ __all__ = [
     "static_schedule", "machine_schedule", "get_context",
     "machine_rank", "local_rank", "suspend", "resume",
     "set_dynamic_topology", "clear_dynamic_topology", "dynamic_schedules",
+    "set_round_parallel", "round_parallel",
 ]
 
 from .windows import (

@@ -43,6 +43,9 @@ class BlueFogTpuContext:
     machine_topology: Optional[nx.DiGraph] = None
     machine_topology_weighted: bool = False
     dynamic_schedules: Optional[List[CommSchedule]] = None
+    # process default for round-parallel gossip emission (None = defer to
+    # BLUEFOG_ROUND_PARALLEL; per-call concurrent= overrides both)
+    round_parallel: Optional[bool] = None
     _sched: Optional[CommSchedule] = None
     _machine_sched: Optional[CommSchedule] = None
 
@@ -423,6 +426,24 @@ def clear_dynamic_topology() -> None:
 
 def dynamic_schedules() -> Optional[List[CommSchedule]]:
     return get_context().dynamic_schedules
+
+
+def set_round_parallel(value: Optional[bool]) -> None:
+    """Set the process default for round-parallel gossip emission.
+
+    ``True`` makes ``neighbor_allreduce`` issue its edge-colored rounds as
+    one concurrent permute group, ``False`` forces the sequential chain,
+    ``None`` defers to the ``BLUEFOG_ROUND_PARALLEL`` env flag.  A per-call
+    ``concurrent=`` argument always wins.  Flipping the knob changes the
+    traced program, so do it before warmup (the retrace sentinel counts a
+    steady-state flip as the recompile it is).
+    """
+    get_context().round_parallel = value
+
+
+def round_parallel() -> Optional[bool]:
+    """The context's round-parallel default (see :func:`set_round_parallel`)."""
+    return get_context().round_parallel
 
 
 def static_schedule() -> CommSchedule:

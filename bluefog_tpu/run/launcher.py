@@ -674,6 +674,19 @@ def maybe_initialize_distributed() -> bool:
 
     if jax.distributed.is_initialized():
         return True
+    # the CPU backend ships with cross-process collectives disabled
+    # (jax_cpu_collectives_implementation defaults to "none"), so a
+    # multi-process CPU mesh would create fine and then fail every
+    # computation with "Multiprocess computations aren't implemented on
+    # the CPU backend".  Flip it to gloo before the first backend client
+    # exists; an explicit user choice (env/abseil flag) is respected.
+    try:
+        from jax._src import xla_bridge as _xb
+        if (not _xb.backends_are_initialized()
+                and _xb.CPU_COLLECTIVES_IMPLEMENTATION.value in (None, "none")):
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass                    # older/newer jax: name gone, TPU unaffected
     coord = os.environ.get("BLUEFOG_COORDINATOR")
     if coord:
         jax.distributed.initialize(

@@ -87,6 +87,31 @@ def color_edges(edges: Sequence[Edge], size: int) -> List[List[Edge]]:
     return rounds
 
 
+def rounds_edge_disjoint(sched: "CommSchedule") -> bool:
+    """True iff the schedule's rounds partition the edge set cleanly.
+
+    Each round must be a partial permutation (no source sends twice, no
+    destination receives twice — ``lax.ppermute``'s own contract) and no
+    directed edge may appear in more than one round.  This is the invariant
+    that makes round-parallel emission
+    (``neighbor_allreduce(concurrent=True)``) semantically identical to the
+    sequential chain: every round reads the SAME input, so rounds commute.
+    :func:`color_edges` guarantees it by construction; this check exists for
+    hand-built schedules and as the tested witness of that guarantee.
+    """
+    seen = set()
+    for round_ in sched.rounds:
+        srcs = [s for s, _ in round_]
+        dsts = [d for _, d in round_]
+        if len(set(srcs)) != len(srcs) or len(set(dsts)) != len(dsts):
+            return False
+        for e in round_:
+            if e in seen:
+                return False
+            seen.add(e)
+    return True
+
+
 # ---------------------------------------------------------------------------
 # Compiled schedule
 # ---------------------------------------------------------------------------

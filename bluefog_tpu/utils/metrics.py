@@ -325,7 +325,8 @@ def record_op(op_name: str, args: Tuple = ()) -> None:
 
 def record_step(duration_s: float, *, steps: int = 1,
                 donated: Optional[bool] = None,
-                fused_k: Optional[int] = None) -> None:
+                fused_k: Optional[int] = None,
+                overlap: Optional[bool] = None) -> None:
     """One train-step call (host wall time around the dispatch)."""
     counter("bluefog_train_steps_total", "optimizer steps executed").inc(steps)
     histogram("bluefog_step_time_s", "per-call step wall time").observe(
@@ -338,6 +339,10 @@ def record_step(duration_s: float, *, steps: int = 1,
     if fused_k is not None:
         gauge("bluefog_step_fused_k", "steps fused per call (lax.scan)"
               ).set(fused_k)
+    if overlap is not None:
+        gauge("bluefog_step_overlap",
+              "1 when the step runs pipelined (one-step-delayed) gossip"
+              ).set(1.0 if overlap else 0.0)
 
 
 # ---------------------------------------------------------------------------

@@ -48,6 +48,22 @@ def test_fuse_unfuse_roundtrip():
                                       np.asarray(b, np.float32))
 
 
+def test_unfuse_traces_to_static_slices():
+    """The unpack offsets are compile-time constants, so the traced program
+    must contain plain ``slice`` primitives only — a ``dynamic_slice``
+    would mean XLA sees data-dependent offsets and inserts bounds clamps
+    the scheduler cannot fold away."""
+    tree = make_tree(np.random.default_rng(2))
+
+    def roundtrip(t):
+        return fusion.fuse_tree(t).unfuse()
+
+    prims = {e.primitive.name
+             for e in jax.make_jaxpr(roundtrip)(tree).eqns}
+    assert "slice" in prims
+    assert "dynamic_slice" not in prims
+
+
 def test_fused_communicator_matches_per_leaf():
     rng = np.random.default_rng(1)
     # distributed pytree: every leaf gets a leading rank axis

@@ -1,0 +1,369 @@
+"""Pipelined gossip: one-step-delayed mixing that overlaps comm with compute.
+
+Pins the overlap execution mode end to end:
+
+* algorithm — delayed CTA follows the exact recursion
+  ``x_{t+1} = A(Comb(x_{t-1}), g(x_t))`` (oracle: the numpy weight matrix),
+  the fused ``lax.scan`` driver threads the in-flight carry bit-for-bit,
+  and pure delayed mixing still contracts consensus monotonically (the
+  AD-PSGD 1-step-staleness guarantee, per parity class);
+* mechanism — an AOT pin on the lowered HLO proves the delayed step's
+  collective-permutes are NOT data-dependent on the update dot-generals
+  (the property that lets XLA's latency-hiding scheduler bury the gossip
+  under compute), with bulk-synchronous ATC as the positive control
+  showing the analysis does detect dependence;
+* round-parallel gossip — ``neighbor_allreduce(concurrent=True)`` emits
+  the edge-colored rounds as one concurrent permute group and matches the
+  sequential chain, under wire compression too; the context knob is part
+  of the compiled-program cache key;
+* contracts — ``overlap=True`` demands a pipelined strategy, ATC refuses
+  ``delayed=True``, and the delayed carry refuses communication skipping.
+"""
+import re
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import optax
+import pytest
+
+import bluefog_tpu as bf
+from bluefog_tpu import optimizers as bfopt
+from bluefog_tpu import schedule as sch
+from bluefog_tpu import topology as tu
+from bluefog_tpu.parallel import context as bfctx
+
+N, D, B = 8, 6, 20
+LR = 0.05
+
+
+def grad_fn(params, batch):
+    A, b = batch
+
+    def loss(w):
+        r = A @ w["w"] - b
+        return jnp.mean(r * r)
+
+    l, g = jax.value_and_grad(loss)(params)
+    return l, g
+
+
+def zero_grad_fn(params, batch):
+    """Isolates the mixing dynamics: x_{t+1} = Comb(x_{t-1}) exactly."""
+    return jnp.zeros(()), jax.tree.map(jnp.zeros_like, params)
+
+
+@pytest.fixture(autouse=True)
+def ctx(cpu_devices):
+    bf.init(devices=cpu_devices, nodes_per_machine=1)
+    bf.set_topology(tu.ExponentialTwoGraph(N), is_weighted=True)
+    yield
+    bf.shutdown()
+
+
+def _data(seed=0):
+    rng = np.random.default_rng(seed)
+    A = jnp.asarray(rng.normal(size=(N, B, D)), jnp.float32)
+    b = jnp.asarray(rng.normal(size=(N, B)), jnp.float32)
+    params = {"w": jnp.asarray(rng.normal(size=(N, D)), jnp.float32)}
+    return params, (A, b)
+
+
+def _delayed_strategy(**kw):
+    return bfopt.adapt_with_combine(
+        optax.sgd(LR), bfopt.neighbor_communicator(bf.static_schedule()),
+        delayed=True, **kw)
+
+
+# ---------------------------------------------------------------------------
+# Algorithm: the delayed recursion, fused driver, consensus contraction
+# ---------------------------------------------------------------------------
+
+def test_delayed_trajectory_matches_recursion():
+    """Delayed CTA == the hand-rolled recursion in float64 oracle space:
+
+        carry_0 = x_0 (the seeded own-params carry, unmixed)
+        x_{t+1} = carry_t - lr * g(x_t),   carry_{t+1} = W^T x_t
+
+    i.e. x_{t+1} = W^T x_{t-1} - lr*g(x_t) from step 2 on, with the first
+    adapt running on the rank's own params."""
+    params, batch = _data()
+    strat = _delayed_strategy()
+    state = bfopt.init_distributed(strat, params)
+    step = bfopt.make_train_step(grad_fn, strat, donate=False, overlap=True)
+
+    W = np.asarray(tu.to_weight_matrix(tu.ExponentialTwoGraph(N)), np.float64)
+    A = np.asarray(batch[0], np.float64)
+    b = np.asarray(batch[1], np.float64)
+
+    def grad(x):                         # d/dw mean((A w - b)^2), per rank
+        r = np.einsum("nij,nj->ni", A, x) - b
+        return 2.0 / B * np.einsum("nij,ni->nj", A, r)
+
+    x_cur = np.asarray(params["w"], np.float64)    # x_t
+    carry = x_cur.copy()                           # seeded carry: own params
+    for _ in range(6):
+        x_next = carry - LR * grad(x_cur)
+        carry = W.T @ x_cur
+        x_cur = x_next
+        params, state, _ = step(params, state, batch)
+        np.testing.assert_allclose(
+            np.asarray(params["w"]), x_cur, rtol=2e-4, atol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(state.comm_state["w"]), carry, rtol=2e-4, atol=1e-5)
+
+
+def test_fused_delayed_trajectory_matches_unfused():
+    """The in-flight mixed params ride the lax.scan carry: k fused delayed
+    steps == k separate delayed calls, params AND carry."""
+    k = 5
+    params, batch = _data(1)
+    strat = _delayed_strategy()
+
+    one = bfopt.make_train_step(grad_fn, strat, donate=False, overlap=True)
+    p1, s1 = params, bfopt.init_distributed(strat, params)
+    for _ in range(k):
+        p1, s1, _ = one(p1, s1, batch)
+
+    fused = bfopt.make_train_step(grad_fn, strat, steps_per_call=k,
+                                  reuse_batch=True, donate=False,
+                                  overlap=True)
+    pk, sk, losses = fused(params, bfopt.init_distributed(strat, params),
+                           batch)
+    assert losses.shape == (N, k)
+    np.testing.assert_allclose(np.asarray(pk["w"]), np.asarray(p1["w"]),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(sk.comm_state["w"]),
+                               np.asarray(s1.comm_state["w"]),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_delayed_mixing_contracts_consensus():
+    """Pure 1-step-delayed mixing on Exp2(8): x_{t+1} = W^T x_{t-1} splits
+    into two interleaved consensus iterations; each parity class must
+    contract monotonically to the (preserved) mean — while the step keeps
+    its donation and no-retrace contracts."""
+    from bluefog_tpu import diagnostics as bfdiag
+
+    params, batch = _data(2)
+    strat = _delayed_strategy()
+    state = bfopt.init_distributed(strat, params)
+    step = bfopt.make_train_step(zero_grad_fn, strat, donate=True,
+                                 overlap=True)
+
+    dists = [float(np.max(bfdiag.consensus_distance(params)))]
+    params, state, _ = step(params, state, batch)     # reshard to the mesh
+    dists.append(float(np.max(bfdiag.consensus_distance(params))))
+    old_w = params["w"]
+    params, state, _ = step(params, state, batch)
+    assert old_w.is_deleted(), "overlap mode must not break donation"
+    steady = step._cache_size()
+    dists.append(float(np.max(bfdiag.consensus_distance(params))))
+    for _ in range(37):
+        params, state, _ = step(params, state, batch)
+        dists.append(float(np.max(bfdiag.consensus_distance(params))))
+    assert step._cache_size() == steady, (
+        "overlap mode must not retrace in steady state")
+
+    # monotone contraction per parity class (the two interleaved chains)
+    for t in range(len(dists) - 2):
+        assert dists[t + 2] <= dists[t] * (1 + 1e-6), (t, dists)
+    assert dists[-1] < 1e-3 * dists[0], dists
+    # the mean is preserved (doubly-stochastic mixing moves no mass)
+    np.testing.assert_allclose(
+        np.asarray(params["w"]).mean(axis=0),
+        np.asarray(_data(2)[0]["w"]).mean(axis=0), rtol=1e-4, atol=1e-5)
+
+
+def test_delayed_concurrent_rounds_same_trajectory():
+    """The round-parallel communicator drops into the delayed strategy
+    without changing the math."""
+    params, batch = _data(3)
+    out = {}
+    for conc in (False, True):
+        strat = bfopt.adapt_with_combine(
+            optax.sgd(LR),
+            bfopt.neighbor_communicator(bf.static_schedule(),
+                                        concurrent=conc),
+            delayed=True)
+        p, s = params, bfopt.init_distributed(strat, params)
+        step = bfopt.make_train_step(grad_fn, strat, donate=False,
+                                     overlap=True)
+        for _ in range(4):
+            p, s, _ = step(p, s, batch)
+        out[conc] = np.asarray(p["w"])
+    np.testing.assert_allclose(out[True], out[False], rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# Mechanism: AOT HLO proof that delayed permutes dodge the update dots
+# ---------------------------------------------------------------------------
+
+_HLO_INSTR_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?([\w.-]+)\s*=\s*\S+\s+([\w-]+)\((.*?)\)")
+_HLO_NAME_RE = re.compile(r"[\w.-]+")
+
+
+def _parse_hlo(hlo_text):
+    """name -> (opcode, operand names) over every instruction line."""
+    ops = {}
+    for line in hlo_text.splitlines():
+        m = _HLO_INSTR_RE.match(line)
+        if not m:
+            continue
+        name, opcode, args = m.groups()
+        operands = []
+        for a in args.split(","):
+            a = a.strip().split("=")[0].strip()
+            if a and _HLO_NAME_RE.fullmatch(a):
+                operands.append(a)
+        ops[name] = (opcode, operands)
+    return ops
+
+
+def _backward_slice(ops, start):
+    seen, stack = set(), [start]
+    while stack:
+        cur = stack.pop()
+        if cur in seen or cur not in ops:
+            continue
+        seen.add(cur)
+        stack.extend(ops[cur][1])
+    return seen
+
+
+def _dots_feeding_permutes(step, params, state, batch):
+    """For each collective-permute in the step's pre-optimization HLO,
+    the dot ops in its transitive operand (backward) slice."""
+    hlo = (step.lower(params, state, batch)
+           .compiler_ir(dialect="hlo").as_hlo_text())
+    ops = _parse_hlo(hlo)
+    perms = [n for n, (oc, _) in ops.items() if oc == "collective-permute"]
+    assert perms, "no collective-permute in lowered step HLO"
+    return {p: sorted(n for n in _backward_slice(ops, p)
+                      if ops[n][0].startswith("dot")) for p in perms}
+
+
+def test_hlo_delayed_permutes_independent_of_update_dots():
+    """The load-bearing dataflow property: in the overlapped step NO
+    collective-permute consumes a dot-general's result — the gossip reads
+    function inputs, so the latency-hiding scheduler is free to run it
+    concurrently with the step's matmuls.  Bulk-synchronous ATC is the
+    positive control: there every permute's slice DOES contain the update
+    dots (gossip input is the update output), proving the analysis
+    detects dependence rather than vacuously passing."""
+    params, batch = _data(4)
+
+    strat = _delayed_strategy()
+    step = bfopt.make_train_step(grad_fn, strat, donate=False, overlap=True)
+    deps = _dots_feeding_permutes(
+        step, params, bfopt.init_distributed(strat, params), batch)
+    assert all(not dots for dots in deps.values()), (
+        "delayed permutes must not depend on dot-generals", deps)
+
+    atc = bfopt.adapt_then_combine(
+        optax.sgd(LR), bfopt.neighbor_communicator(bf.static_schedule()))
+    astep = bfopt.make_train_step(grad_fn, atc, donate=False)
+    adeps = _dots_feeding_permutes(
+        astep, params, bfopt.init_distributed(atc, params), batch)
+    assert all(dots for dots in adeps.values()), (
+        "positive control: ATC permutes must depend on the update dots "
+        "(else the analysis is vacuous)", adeps)
+
+
+# ---------------------------------------------------------------------------
+# Round-parallel gossip: equivalence, schedule witness, cache key
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("wire", [None, "bf16", "fp8"])
+def test_round_parallel_matches_sequential(wire):
+    """Concurrent emission of the edge-colored rounds == the sequential
+    chain: every round reads the SAME input and the combine runs in round
+    order, so the values agree to float tolerance — wire codecs included."""
+    sched = bf.static_schedule()
+    assert sched.num_rounds > 1, "Exp2(8) must need multiple rounds"
+    assert sch.rounds_edge_disjoint(sched), (
+        "color_edges must produce edge-disjoint partial permutations")
+    rng = np.random.default_rng(5)
+    x = jnp.asarray(rng.normal(size=(N, 7, 3)), jnp.float32)
+    seq = np.asarray(bf.neighbor_allreduce(x, wire=wire, concurrent=False))
+    conc = np.asarray(bf.neighbor_allreduce(x, wire=wire, concurrent=True))
+    np.testing.assert_allclose(conc, seq, rtol=1e-6, atol=1e-6)
+
+
+def test_round_parallel_fixed_point():
+    """Consensus is a fixed point of the concurrent path too (weights
+    still sum to one per rank)."""
+    x = jnp.broadcast_to(jnp.arange(D, dtype=jnp.float32), (N, D))
+    out = bf.neighbor_allreduce(x, concurrent=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_round_parallel_context_knob_in_cache_key():
+    """set_round_parallel flips the default AND the compiled-program cache
+    key — the knob must never serve a program traced under the other
+    setting."""
+    bfctx.clear_program_cache()
+    x = jnp.ones((N, 4), jnp.float32)
+    assert bf.round_parallel() is None
+    bf.neighbor_allreduce(x)                        # sequential default
+    m0 = bfctx.program_cache_stats()["misses"]
+    bf.set_round_parallel(True)
+    try:
+        assert bf.round_parallel() is True
+        y = bf.neighbor_allreduce(x)                # NEW program, not cached
+        jax.block_until_ready(y)
+        assert bfctx.program_cache_stats()["misses"] == m0 + 1
+        y2 = bf.neighbor_allreduce(x)               # now cached
+        jax.block_until_ready(y2)
+        assert bfctx.program_cache_stats()["misses"] == m0 + 1
+    finally:
+        bf.set_round_parallel(None)
+    assert bf.round_parallel() is None
+
+
+def test_round_parallel_env_default(monkeypatch):
+    """BLUEFOG_ROUND_PARALLEL=1 turns the knob on when the context does
+    not pin it; an explicit context setting wins over the env."""
+    from bluefog_tpu.ops.collectives import _default_concurrent
+    monkeypatch.setenv("BLUEFOG_ROUND_PARALLEL", "1")
+    assert _default_concurrent() is True
+    bf.set_round_parallel(False)
+    try:
+        assert _default_concurrent() is False
+    finally:
+        bf.set_round_parallel(None)
+    monkeypatch.setenv("BLUEFOG_ROUND_PARALLEL", "0")
+    assert _default_concurrent() is False
+
+
+# ---------------------------------------------------------------------------
+# Contracts
+# ---------------------------------------------------------------------------
+
+def test_overlap_requires_pipelined_strategy():
+    strat = bfopt.adapt_with_combine(
+        optax.sgd(LR), bfopt.neighbor_communicator(bf.static_schedule()))
+    with pytest.raises(ValueError, match="pipelined"):
+        bfopt.make_train_step(grad_fn, strat, overlap=True)
+    with pytest.raises(ValueError, match="pipelined"):
+        bfopt.make_stateful_train_step(
+            lambda p, ns, b: (jnp.zeros(()), jax.tree.map(jnp.zeros_like, p),
+                              ns),
+            strat, overlap=True)
+
+
+def test_atc_refuses_delayed():
+    with pytest.raises(ValueError, match="adapt_then_combine"):
+        bfopt.adapt_then_combine(
+            optax.sgd(LR),
+            bfopt.neighbor_communicator(bf.static_schedule()), delayed=True)
+
+
+def test_delayed_refuses_communication_skipping():
+    with pytest.raises(ValueError, match="num_steps_per_communication"):
+        bfopt.adapt_with_combine(
+            optax.sgd(LR),
+            bfopt.neighbor_communicator(bf.static_schedule()),
+            delayed=True, num_steps_per_communication=2)

@@ -127,6 +127,64 @@ def test_obs_trace_fixture_arithmetic():
     assert abs(out["idle_ms"] - 10.0) < 1e-6
 
 
+def test_canonical_op_strips_instance_suffixes():
+    ta = _load("trace_analyze")
+    assert ta.canonical_op("collective-permute-start.5") == \
+        "collective-permute-start"
+    assert ta.canonical_op("all-reduce.2.1") == "all-reduce"
+    assert ta.canonical_op("fusion") == "fusion"
+    assert ta.canonical_op("") == ""
+
+
+def test_overlap_trace_fixture_per_op_attribution():
+    """The committed overlapped-step fixture (`make overlap-smoke` runs the
+    CLI on the same file): compute [0,140)+[150,200), comm
+    [10,50)+[120,160)+[200,220).  Aggregate: comm 100ms, exposed
+    [140,150)+[200,220) = 30ms, overlap 0.70, wall 220ms, idle 0.
+    Per-op: the two permute-starts canonicalize to one row (80ms total,
+    10ms exposed); the trailing permute-done is fully exposed (20ms) and
+    must rank first."""
+    ta = _load("trace_analyze")
+    doc = json.load(open(
+        os.path.join(REPO, "tests", "fixtures", "overlap_trace.trace.json")))
+    out = ta.analyze(doc["traceEvents"])
+    assert out["ok"] and out["n_events"] == 6       # host track excluded
+    assert abs(out["wall_ms"] - 220.0) < 1e-6
+    assert abs(out["compute_ms"] - 190.0) < 1e-6
+    assert abs(out["comm_ms"] - 100.0) < 1e-6
+    assert abs(out["comm_exposed_ms"] - 30.0) < 1e-6
+    assert abs(out["overlap_fraction"] - 0.70) < 1e-3
+    assert abs(out["idle_ms"] - 0.0) < 1e-6
+    rows = out["top_exposed_comm_ops"]
+    assert [r["name"] for r in rows] == [
+        "collective-permute-done", "collective-permute-start"]
+    assert rows[0]["count"] == 1
+    assert abs(rows[0]["total_ms"] - 20.0) < 1e-6
+    assert abs(rows[0]["exposed_ms"] - 20.0) < 1e-6
+    assert rows[1]["count"] == 2
+    assert abs(rows[1]["total_ms"] - 80.0) < 1e-6
+    assert abs(rows[1]["exposed_ms"] - 10.0) < 1e-6
+
+
+def test_top_exposed_comm_ops_on_obs_fixture():
+    """Per-op attribution over the obs fixture, hand-checked: the ragged
+    all-to-all owns 30 of the 40 exposed ms, the fusion-wrapped permute
+    owns 20 (their [120,130) overlap is attributed to BOTH — per-op rows
+    may double-count time that two comm ops expose simultaneously, so the
+    rows bound the aggregate from above), the async all-reduce halves are
+    fully hidden and tie-break by name."""
+    ta = _load("trace_analyze")
+    doc = json.load(open(
+        os.path.join(REPO, "tests", "fixtures", "obs_trace.trace.json")))
+    out = ta.analyze(doc["traceEvents"])
+    rows = out["top_exposed_comm_ops"]
+    assert [r["name"] for r in rows] == [
+        "ragged-all-to-all", "loop_fusion.collective-permute-start",
+        "all-reduce-done", "all-reduce-start"]
+    assert [r["exposed_ms"] for r in rows] == [30.0, 20.0, 0.0, 0.0]
+    assert sum(r["exposed_ms"] for r in rows) >= out["comm_exposed_ms"]
+
+
 def test_perf_fill_renders_and_is_idempotent(tmp_path, monkeypatch):
     measured = tmp_path / "measured"
     measured.mkdir()

@@ -21,6 +21,12 @@ Reported numbers:
     comm_exposed_ms    comm intervals minus compute coverage
     overlap_fraction   1 - exposed/comm (1.0 = fully hidden)
     idle_ms            wall - union(all device intervals) — dispatch gaps
+    top_exposed_comm_ops  per-op attribution of the exposed time: comm
+                       events grouped by canonical op name (trailing
+                       ``.N`` instance suffix stripped), each group's
+                       intervals measured against the compute cover,
+                       top-k by exposed ms — so a regression names the
+                       offending collective instead of an aggregate
 
 Run: python tools/trace_analyze.py <trace_dir_or_file> [--out out.json]
 """
@@ -95,6 +101,38 @@ def subtract(base, cover):
     return total
 
 
+_INSTANCE_RE = re.compile(r"(\.\d+)+$")
+
+
+def canonical_op(name):
+    """Collapse per-instance HLO names: ``collective-permute-start.5`` and
+    ``collective-permute-start.12`` are the same op for attribution."""
+    return _INSTANCE_RE.sub("", name or "")
+
+
+def top_exposed_comm_ops(comm_events, comp_cover, k=5):
+    """Per-op exposed time: group comm events by canonical name, measure
+    each group's merged intervals against the compute cover.  Returns the
+    top-k groups by exposed ms (ties broken by total ms), each as
+    ``{"name", "count", "total_ms", "exposed_ms"}``."""
+    by_name = {}
+    for ev in comm_events:
+        iv = (float(ev["ts"]), float(ev["ts"]) + float(ev["dur"]))
+        by_name.setdefault(canonical_op(ev.get("name", "")), []).append(iv)
+    us = 1e-3
+    rows = []
+    for name, ivs in by_name.items():
+        merged, total = merge(ivs)
+        rows.append({
+            "name": name,
+            "count": len(ivs),
+            "total_ms": round(total * us, 3),
+            "exposed_ms": round(subtract(merged, comp_cover) * us, 3),
+        })
+    rows.sort(key=lambda r: (-r["exposed_ms"], -r["total_ms"], r["name"]))
+    return rows[:k]
+
+
 def analyze(events):
     pid_names = {}
     for ev in events:
@@ -115,10 +153,14 @@ def analyze(events):
     if not xs:
         return {"ok": False, "error": "no complete events on device tracks"}
 
-    comm_iv, comp_iv = [], []
+    comm_iv, comp_iv, comm_events = [], [], []
     for ev in xs:
         iv = (float(ev["ts"]), float(ev["ts"]) + float(ev["dur"]))
-        (comm_iv if COMM_RE.search(ev.get("name", "")) else comp_iv).append(iv)
+        if COMM_RE.search(ev.get("name", "")):
+            comm_iv.append(iv)
+            comm_events.append(ev)
+        else:
+            comp_iv.append(iv)
     comm_m, comm_total = merge(comm_iv)
     comp_m, comp_total = merge(comp_iv)
     all_m, busy_total = merge(comm_iv + comp_iv)
@@ -136,6 +178,7 @@ def analyze(events):
         "overlap_fraction": (round(1.0 - exposed / comm_total, 4)
                              if comm_total > 0 else None),
         "idle_ms": round((wall - busy_total) * us, 3),
+        "top_exposed_comm_ops": top_exposed_comm_ops(comm_events, comp_m),
     }
 
 

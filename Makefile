@@ -6,7 +6,7 @@ PY ?= python
 
 .PHONY: test test-fast test_basic test_ops test_win_ops test_optimizer \
 	test_hier test_native test_examples verify native clean hw-watch \
-	obs-smoke chaos-smoke overlap-smoke
+	obs-smoke chaos-smoke overlap-smoke postmortem-smoke
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -106,6 +106,24 @@ overlap-smoke:
 		assert rows and all(set(r) == {'name', 'count', 'total_ms', \
 		'exposed_ms'} for r in rows), rows; \
 		print('overlap-smoke OK')"
+
+# postmortem smoke: merge the committed two-rank flight bundles (rank 1
+# chaos-killed at step 30, rank 0 SIGTERM'd by the teardown) and check the
+# verdict schema — bundle/report format drift fails here (and in tier-1,
+# via the same fixtures in tests/test_flight.py)
+postmortem-smoke:
+	$(PY) tools/postmortem.py \
+		tests/fixtures/flight_rank0.json \
+		tests/fixtures/flight_rank1.json \
+		--out /tmp/postmortem_report.json
+	$(PY) -c "import json; \
+		d = json.load(open('/tmp/postmortem_report.json')); \
+		assert d['ok'] and d['schema'] == 'bluefog-flight-1', d; \
+		assert all(k in d for k in ('verdict', 'per_rank', 'step_time', \
+		'consensus', 'topology')), d; \
+		v = d['verdict']; \
+		assert v['first_failed_rank'] == 1 and v['failure_step'] == 30, v; \
+		print('postmortem-smoke OK')"
 
 # resilience smoke: deterministic fault injection + healing/rollback on
 # the virtual CPU mesh (kill->heal->contract, NaN->rollback, restart

@@ -53,9 +53,12 @@ from .api import (
     barrier, synchronize, poll, hard_sync, resolve_schedule, shard_distributed,
 )
 from . import diagnostics
-from .diagnostics import diagnose_consensus, consensus_distance, check_finite
+from .diagnostics import (
+    diagnose_consensus, consensus_distance, check_finite, detect_stragglers,
+)
 from . import resilience
 from .resilience import mark_rank_dead, dead_ranks, guard_step
 from .utils import chaos
+from .utils import flight
 
 __version__ = "0.1.0"

@@ -28,6 +28,7 @@ from . import ops
 from .parallel import context as _mesh
 from .schedule import CommSchedule, compile_from_weights
 from .utils import chaos as _chaos
+from .utils import flight as _flight
 from .utils import metrics as _metrics
 from .utils import timeline as _tl
 
@@ -45,6 +46,7 @@ def _dispatch(op_name, fn, *args):
     loop records (``test/timeline_test.py:54-117``) — and count the call +
     payload bytes in the metrics registry."""
     _metrics.record_op(op_name, args)
+    _flight.record_op(op_name)
     with _tl.op_span(op_name):
         out = fn(*args)
     # fault injection (zero-cost gate: one attribute load when no plan is

@@ -40,11 +40,14 @@ from jax.sharding import PartitionSpec as P
 from . import ops
 from .parallel import context as _mesh
 from .schedule import CommSchedule
+from .utils import chaos as _chaos
+from .utils import flight as _flight
 from .utils import metrics as _metrics
 
 __all__ = ["diagnose_consensus", "consensus_distance", "window_staleness",
            "check_finite", "record_peer_failure", "observe_peer_finiteness",
-           "peer_health", "unhealthy_ranks", "reset_peer_health"]
+           "peer_health", "unhealthy_ranks", "reset_peer_health",
+           "observe_step_time", "last_step_times", "detect_stragglers"]
 
 
 def _float_mask(tree) -> tuple:
@@ -67,12 +70,22 @@ def _flat_f32(tree) -> jax.Array:
 
 
 def _probe_program(ctx, sched: Optional[CommSchedule], sig,
-                   dead: tuple = ()):
+                   dead: tuple = (), with_time: bool = False):
     """Compiled probe: distributed params -> (distance [n], disagreement [n]).
 
     ``dead`` restricts the network average (and the disagreement mask) to
     the surviving ranks: the resilience layer's view of consensus after a
     rank death — dead ranks report 0 and contribute nothing to the mean.
+
+    ``with_time`` piggybacks each rank's last step wall-time (a second
+    ``[n]`` f32 input) on the same collectives: the scalar rides as one
+    extra element concatenated onto the gathered vector — no additional
+    collective, no change to the distance/disagreement math (the norms are
+    computed on the parameter part only) — and the probe returns two more
+    ``[n]`` outputs: each rank's own time (echo) and the max over its
+    in-neighbors' times, the straggler detector's raw signal.  The flag is
+    part of the program-cache key, so callers without times keep hitting
+    their original compiled probe.
     """
     n = ctx.size
     alive = np.ones(n, np.float32)
@@ -88,33 +101,47 @@ def _probe_program(ctx, sched: Optional[CommSchedule], sig,
             for k, src in enumerate(sched.in_neighbors[d]):
                 slot_alive[d, k] = alive[src]
 
-    def per_rank(tree):
+    def per_rank(tree, tvec=None):
         v = _flat_f32(jax.tree.map(lambda x: x[0], tree))
         me = lax.axis_index("rank")
         me_alive = jnp.asarray(alive)[me]
         vbar = lax.psum(v * me_alive, "rank") / n_alive
         dist = jnp.sqrt(jnp.sum((v - vbar) ** 2)) * me_alive
+        t_me = (tvec.reshape(1).astype(jnp.float32)
+                if tvec is not None else None)
+        payload = v if t_me is None else jnp.concatenate([v, t_me])
+        nbr_tmax = t_me
         if sched is not None and sched.max_in_degree > 0:
-            g = ops.neighbor_allgather(v, sched, axis="rank")
-            g = g.reshape(slots, v.shape[0])
+            g = ops.neighbor_allgather(payload, sched, axis="rank")
+            g = g.reshape(slots, payload.shape[0])
+            if t_me is not None:
+                g, gt = g[:, :-1], g[:, -1]
             diffs = jnp.sqrt(jnp.sum((g - v[None, :]) ** 2, axis=1))
             # trailing slots on low-degree ranks are zero-filled, not
             # neighbor values — mask by static in-degree and liveness
             mask = jnp.asarray(slot_alive)[me]
-            disagree = jnp.max(jnp.where(
-                (jnp.arange(slots) < jnp.asarray(in_deg)[me]) & (mask > 0),
-                diffs, 0.0)) * me_alive
+            valid = (jnp.arange(slots) < jnp.asarray(in_deg)[me]) & (mask > 0)
+            disagree = jnp.max(jnp.where(valid, diffs, 0.0)) * me_alive
+            if t_me is not None:
+                nbr_tmax = jnp.max(
+                    jnp.where(valid, gt, 0.0), keepdims=True)
         else:
             disagree = jnp.zeros((), jnp.float32)
-        return dist[None], disagree[None]
+        if t_me is None:
+            return dist[None], disagree[None]
+        return dist[None], disagree[None], t_me, nbr_tmax
 
     def build():
+        n_in = 2 if with_time else 1
+        specs = tuple([P("rank")] * n_in)
+        out_specs = tuple([P("rank")] * (4 if with_time else 2))
         return jax.jit(jax.shard_map(
-            per_rank, mesh=ctx.mesh, in_specs=P("rank"),
-            out_specs=(P("rank"), P("rank"))))
+            per_rank, mesh=ctx.mesh,
+            in_specs=specs if with_time else P("rank"),
+            out_specs=out_specs))
 
     return _mesh.cached_program(
-        ("diag-consensus", sched, ctx.mesh, sig, dead), build)
+        ("diag-consensus", sched, ctx.mesh, sig, dead, with_time), build)
 
 
 def consensus_distance(params: Any,
@@ -138,7 +165,9 @@ def window_staleness() -> Dict[str, int]:
 def diagnose_consensus(params: Any, *,
                        schedule: Optional[CommSchedule] = None,
                        dead_ranks: Sequence[int] = (),
-                       record: bool = True) -> Dict[str, Any]:
+                       record: bool = True,
+                       step_times: Optional[Sequence[float]] = None,
+                       ) -> Dict[str, Any]:
     """One health sample over distributed ``params``.
 
     Returns consensus distance (per-rank array + max/mean), max neighbor
@@ -148,6 +177,14 @@ def diagnose_consensus(params: Any, *,
     (the resilience layer's view: the network average excludes dead ranks,
     which report distance 0).  ``record=True`` also publishes the scalars
     as registry gauges so the exporters pick them up.
+
+    ``step_times`` (an ``[n]`` per-rank last-step wall-time vector, e.g.
+    :func:`observe_step_time`'s table) piggybacks on the probe's existing
+    masked neighbor_allgather — one extra scalar per rank, no additional
+    collective — and extends the result with ``step_time_s`` (per rank),
+    ``step_time_skew_s``, ``neighbor_step_time_max``, and
+    ``straggler_ranks``, plus the ``bluefog_step_time_skew`` /
+    ``bluefog_straggler_rank`` gauges when recording.
     """
     ctx = _mesh.get_context()
     if schedule is None:
@@ -158,8 +195,19 @@ def diagnose_consensus(params: Any, *,
     dead = tuple(sorted(set(int(r) for r in dead_ranks)))
     if dead and len(dead) >= ctx.size:
         raise ValueError(f"all {ctx.size} ranks marked dead")
-    fn = _probe_program(ctx, schedule, _float_mask(params), dead)
-    dist, disagree = fn(params)
+    with_time = step_times is not None
+    fn = _probe_program(ctx, schedule, _float_mask(params), dead,
+                        with_time=with_time)
+    if with_time:
+        t_host = np.asarray(step_times, np.float32).reshape(-1)
+        if t_host.size != ctx.size:
+            raise ValueError(
+                f"step_times has {t_host.size} entries for {ctx.size} ranks")
+        from . import api as _api
+        dist, disagree, t_echo, nbr_tmax = fn(
+            params, _api.shard_distributed(jnp.asarray(t_host)))
+    else:
+        dist, disagree = fn(params)
     dist = np.asarray(dist)
     disagree = np.asarray(disagree)
     alive = [r for r in range(ctx.size) if r not in dead]
@@ -172,6 +220,15 @@ def diagnose_consensus(params: Any, *,
         "neighbor_disagreement_max": float(disagree.max()),
         "window_staleness": staleness,
     }
+    if with_time:
+        global _last_step_times
+        t = np.asarray(t_echo).reshape(-1)
+        _last_step_times = t
+        stragglers = detect_stragglers(t, dead_ranks=dead)
+        out["step_time_s"] = t
+        out["step_time_skew_s"] = float(t[alive].max() - t[alive].min())
+        out["neighbor_step_time_max"] = np.asarray(nbr_tmax).reshape(-1)
+        out["straggler_ranks"] = stragglers
     if record:
         _metrics.gauge("bluefog_consensus_distance_max",
                        "max over ranks of ||x_i - mean(x)||"
@@ -186,7 +243,92 @@ def diagnose_consensus(params: Any, *,
             _metrics.gauge("bluefog_window_staleness_max",
                            "max unconsumed mailbox deliveries"
                            ).set(max(staleness.values()))
+        if with_time:
+            _metrics.gauge(
+                "bluefog_step_time_skew",
+                "max - min of per-rank last-step wall time (s)"
+                ).set(out["step_time_skew_s"])
+            _metrics.gauge(
+                "bluefog_straggler_rank",
+                "slowest rank when it qualifies as a straggler, else -1"
+                ).set(float(out["straggler_ranks"][0])
+                      if out["straggler_ranks"] else -1.0)
+        ev = {"max": out["consensus_distance_max"],
+              "mean": out["consensus_distance_mean"],
+              "disagree": out["neighbor_disagreement_max"]}
+        if with_time:
+            ev["step_times"] = [round(float(x), 6) for x in t]
+            ev["skew_s"] = out["step_time_skew_s"]
+            ev["stragglers"] = list(out["straggler_ranks"])
+        _flight.record("consensus", **ev)
     return out
+
+
+# ---------------------------------------------------------------------------
+# Live straggler detection (per-rank step times through the same probe)
+# ---------------------------------------------------------------------------
+
+_last_step_times: Optional[np.ndarray] = None
+
+
+def observe_step_time(duration_s: float,
+                      size: Optional[int] = None) -> Optional[np.ndarray]:
+    """Fold one host-measured step wall time into the per-rank table.
+
+    In a multi-process job each host measures its own ranks, so the table
+    is simply ``duration_s`` everywhere (only the local shard feeds the
+    probe).  In the single-process SPMD simulation every rank shares one
+    host clock — per-rank attribution comes from the chaos ledger: sleep
+    seconds injected by rank-targeted ``hang``/``throttle`` faults are
+    subtracted from the shared baseline and re-added to their target rank,
+    so an injected straggler *looks* like a real one to the detector.
+    Returns the ``[n]`` table (also kept for :func:`detect_stragglers`),
+    or None when the context is not initialized.
+    """
+    global _last_step_times
+    if size is None:
+        if not _mesh.is_initialized():
+            return None
+        size = _mesh.get_context().size
+    delays = _chaos.consume_step_delays()
+    base = max(float(duration_s) - sum(delays.values()), 0.0)
+    t = np.full(size, base, np.float32)
+    for r, d in delays.items():
+        if 0 <= r < size:
+            t[r] += d
+    _last_step_times = t
+    return t
+
+
+def last_step_times() -> Optional[np.ndarray]:
+    """The most recent per-rank step-time table (observe/diagnose feed it)."""
+    return _last_step_times
+
+
+def detect_stragglers(step_times: Optional[Sequence[float]] = None, *,
+                      factor: float = 2.0, min_skew_s: float = 0.0,
+                      dead_ranks: Sequence[int] = ()) -> Tuple[int, ...]:
+    """Ranks whose last step took ``> factor ×`` the alive-rank median
+    (and at least ``min_skew_s`` over it) — slowest first.
+
+    Uses ``step_times`` when given, else the last observed table (fed by
+    :func:`observe_step_time` / the ``metrics_every_k`` probe).  The median
+    baseline makes the verdict robust to up to half the ranks slowing down
+    together (a global slowdown is not a straggler).
+    """
+    t = (np.asarray(step_times, np.float64).reshape(-1)
+         if step_times is not None else _last_step_times)
+    if t is None or t.size == 0:
+        return ()
+    t = np.asarray(t, np.float64).reshape(-1)
+    dead = {int(r) for r in dead_ranks}
+    alive = [r for r in range(t.size) if r not in dead]
+    if not alive:
+        return ()
+    med = float(np.median(t[alive]))
+    out = [r for r in alive
+           if t[r] > factor * med and t[r] - med > min_skew_s]
+    return tuple(sorted(out, key=lambda r: -t[r]))
 
 
 # ---------------------------------------------------------------------------

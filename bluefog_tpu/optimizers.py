@@ -49,6 +49,7 @@ from .ops import windows as wops
 from .parallel import context as _mesh
 from .schedule import CommSchedule
 from .utils import chaos as _chaos
+from .utils import flight as _flight
 from .utils import metrics as _metrics
 from .utils.timeline import named_span
 
@@ -1318,13 +1319,23 @@ class _InstrumentedStep:
 
     def __call__(self, *args, **kwargs):
         import time as _time
+        call = self._calls + 1
+        _flight.record("step_begin", name="train_step", step=call)
         t0 = _time.perf_counter()
-        # fault injection (zero-cost gate when no plan is installed): a
-        # kill/hang/throttle fault fires BEFORE dispatch — the sleep lands
-        # in the step-time metrics, which is how a straggler looks for real
-        if _chaos._plan is not None:
-            _chaos.on_train_step(self._calls + 1)
-        out = self._fn(*args, **kwargs)
+        try:
+            # fault injection (zero-cost gate when no plan is installed): a
+            # kill/hang/throttle fault fires BEFORE dispatch — the sleep
+            # lands in the step-time metrics, which is how a straggler
+            # looks for real
+            if _chaos._plan is not None:
+                _chaos.on_train_step(call)
+            out = self._fn(*args, **kwargs)
+        except BaseException as e:
+            # flush the black box before the exception unwinds the train
+            # loop (the launcher/supervisor may take the process down next)
+            _flight.note_failure(
+                "exception", detail=f"{type(e).__name__}: {e}", step=call)
+            raise
         dt = _time.perf_counter() - t0
         self._calls += 1
         # payload corruption touches only the step OUTPUTS (donation-safe,
@@ -1335,10 +1346,17 @@ class _InstrumentedStep:
                              donated=self._donated,
                              fused_k=self._steps_per_call,
                              overlap=self._overlap)
+        _flight.record("step_end", name="train_step", step=self._calls,
+                       dur_s=round(dt, 6), fused_k=self._steps_per_call,
+                       overlap=self._overlap, donated=self._donated)
+        from . import diagnostics as _diag
+        # per-rank step-time table every call (a host-side numpy fill):
+        # chaos-injected sleeps are attributed per step, not lumped into
+        # whichever call the probe happens to sample
+        step_times = _diag.observe_step_time(dt)
         k = self._metrics_every_k
         if k and (self._calls == 1 or self._calls % k == 0):
-            from . import diagnostics as _diag
-            _diag.diagnose_consensus(out[0])
+            _diag.diagnose_consensus(out[0], step_times=step_times)
         if self._calls >= self._warmup:
             size = self._jit_cache_len()
             if (_metrics.in_steady_state() and size is not None

@@ -216,6 +216,9 @@ def init(
     _metrics.maybe_start_from_env()
     from ..utils import chaos as _chaos
     _chaos.maybe_install_from_env()
+    from ..utils import flight as _flight
+    _flight.maybe_enable_from_env()
+    _flight.record("lifecycle", name="init", devices=n)
     if n % nodes_per_machine != 0:
         raise ValueError(
             f"device count {n} not divisible by nodes_per_machine {nodes_per_machine}")
@@ -272,6 +275,8 @@ def shutdown() -> None:
     from ..utils.timeline import stop_timeline
     from ..utils import metrics as _metrics
     from ..utils import chaos as _chaos
+    from ..utils import flight as _flight
+    _flight.record("lifecycle", name="shutdown")
     stop_timeline()
     _metrics.stop_metrics()   # final JSONL sample + close
     _metrics.mark_steady_state(False)

@@ -31,6 +31,7 @@ from jax.sharding import PartitionSpec as P
 from ..ops import windows as wops
 from ..schedule import CommSchedule, compile_from_weights
 from ..utils import chaos as _chaos
+from ..utils import flight as _flight
 from ..utils import metrics as _metrics
 from . import context as _mesh
 
@@ -203,6 +204,7 @@ def _move(kind: str, tensor_or_none, name: str, dst_weights,
     _metrics.record_op(
         "win_" + kind,
         () if tensor_or_none is None else (tensor_or_none,))
+    _flight.record_op("win_" + kind)
     sched = (_dst_schedule(entry.sched, dst_weights)
              if dst_weights is not None else entry.sched)
     slots = entry.window.recv.shape[1]

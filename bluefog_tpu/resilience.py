@@ -39,6 +39,7 @@ from . import diagnostics as _diag
 from . import topology as topo_util
 from .parallel import context as _mesh
 from .schedule import CommSchedule, compile_from_weights
+from .utils import flight as _flight
 from .utils import metrics as _metrics
 
 __all__ = [
@@ -183,6 +184,8 @@ def mark_rank_dead(*ranks: int) -> Tuple[int, ...]:
     _metrics.gauge("bluefog_dead_ranks",
                    "ranks currently marked dead and healed around"
                    ).set(len(merged))
+    _flight.record("heal", name="mark_rank_dead",
+                   new=sorted(new), dead=list(merged))
     try:
         from .utils import timeline as _tl
         now = _tl._now_us()
@@ -283,6 +286,11 @@ class GuardedStep:
         _metrics.counter(
             "bluefog_nonfinite_steps_total",
             "train steps whose outputs failed the finite guard").inc()
+        # dump-on-failure: the poisoned step is about to be rolled back —
+        # capture the run-up (which ops/steps/faults preceded it) now
+        _flight.note_failure(
+            "nonfinite", detail=f"ranks {bad} failed the finite guard",
+            step=self.calls)
         try:
             from .utils import timeline as _tl
             _tl.record_span(
@@ -297,6 +305,7 @@ class GuardedStep:
                 f"{self.calls} with no good snapshot to roll back to "
                 "(guard installed after the blow-up?)")
         self.rollbacks += 1
+        _flight.record("rollback", name="guard_step", step=self.calls)
         return restored
 
 

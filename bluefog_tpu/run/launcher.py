@@ -97,6 +97,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--metrics-port", type=int, default=None,
                    help="serve Prometheus text exposition on this port "
                         "(sets BLUEFOG_METRICS_PORT; endpoint: /metrics)")
+    p.add_argument("--flight-dir", default=None,
+                   help="collect every rank's flight-recorder bundle in "
+                        "this directory (sets BLUEFOG_FLIGHT_DIR: each "
+                        "rank dumps its black box on failure/SIGTERM/exit; "
+                        "merge with tools/postmortem.py)")
     p.add_argument("-x", "--env", action="append", default=[],
                    help="extra NAME=VALUE env for the child (repeatable)")
     p.add_argument("--restart-limit", type=int, default=0,
@@ -160,6 +165,8 @@ def _child_env(args) -> dict:
         env["BLUEFOG_METRICS"] = args.metrics_filename
     if args.metrics_port is not None:
         env["BLUEFOG_METRICS_PORT"] = str(args.metrics_port)
+    if args.flight_dir:
+        env["BLUEFOG_FLIGHT_DIR"] = os.path.abspath(args.flight_dir)
     if not args.no_xla_tuning:
         from ..utils.config import (
             RECOMMENDED_TPU_XLA_FLAGS, looks_like_tpu_environment)
@@ -298,7 +305,8 @@ def _multihost_fanout(args, env) -> int:
         respawn=lambda rank, _count: subprocess.Popen(plans[rank][2]),
         restart_limit=args.restart_limit,
         restart_backoff=args.restart_backoff,
-        labels=[f"rank {pid} on {host}" for host, pid, _ in plans])
+        labels=[f"rank {pid} on {host}" for host, pid, _ in plans],
+        flight_dir=env.get("BLUEFOG_FLIGHT_DIR"))
 
 
 def _count_restart() -> None:
@@ -308,9 +316,29 @@ def _count_restart() -> None:
         "rank respawns performed by the launcher supervisor").inc()
 
 
+def _report_flight_bundles(flight_dir, say) -> None:
+    """After a job failure, say which per-rank flight bundles landed in the
+    collection directory (the children wrote them on failure/SIGTERM) and
+    how to turn them into a verdict."""
+    if not flight_dir:
+        return
+    try:
+        bundles = sorted(f for f in os.listdir(flight_dir)
+                         if f.startswith("flight_rank")
+                         and f.endswith(".json"))
+    except OSError:
+        bundles = []
+    if bundles:
+        say(f"collected {len(bundles)} flight bundle(s) in {flight_dir}: "
+            + ", ".join(bundles))
+        say(f"postmortem: python tools/postmortem.py --dir {flight_dir}")
+    else:
+        say(f"no flight bundles found in {flight_dir}")
+
+
 def _supervise_procs(procs, respawn=None, *, restart_limit=0,
                      restart_backoff=1.0, labels=None,
-                     poll_interval=0.2) -> int:
+                     poll_interval=0.2, flight_dir=None) -> int:
     """Supervise one Popen per rank; the shared exit path for ``-np`` and
     ``-H`` launches.
 
@@ -383,6 +411,7 @@ def _supervise_procs(procs, respawn=None, *, restart_limit=0,
                 if q.returncode:
                     say(f"{labels[r]} exited with code {q.returncode} "
                         "during teardown")
+            _report_flight_bundles(flight_dir, say)
             say(f"job failed: {labels[rank]} exited with code {code}"
                 + (f" after {restarts[rank]} restart(s)"
                    if restarts[rank] else ""))
@@ -656,7 +685,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             respawn=lambda rank, count: _spawn_local_worker(
                 rank, n, coordinator, env, cmd, restart_count=count),
             restart_limit=args.restart_limit,
-            restart_backoff=args.restart_backoff)
+            restart_backoff=args.restart_backoff,
+            flight_dir=env.get("BLUEFOG_FLIGHT_DIR"))
 
     if args.coordinator:
         _apply_coordinator_env(args, env)

@@ -14,6 +14,7 @@ from .utility import (
 from .config import env_flag, env_int, env_float
 from .watchdog import synchronize_with_watchdog
 from . import chaos
+from . import flight
 
 __all__ = [
     "timeline_start_activity", "timeline_end_activity", "timeline_context",
@@ -25,5 +26,5 @@ __all__ = [
     "broadcast_optimizer_state",
     "env_flag", "env_int", "env_float",
     "synchronize_with_watchdog",
-    "chaos",
+    "chaos", "flight",
 ]

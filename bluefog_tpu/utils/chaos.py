@@ -54,7 +54,7 @@ __all__ = [
     "Fault", "ChaosPlan", "RankKilled",
     "install", "uninstall", "active", "current_plan",
     "maybe_install_from_env", "on_train_step", "corrupt_train_output",
-    "on_eager_op",
+    "on_eager_op", "consume_step_delays",
 ]
 
 ENV_VAR = "BLUEFOG_CHAOS"
@@ -241,6 +241,8 @@ def install(plan) -> ChaosPlan:
 def uninstall() -> None:
     global _plan
     _plan = None
+    with _delay_lock:
+        _step_delays.clear()
 
 
 def active() -> bool:
@@ -264,28 +266,88 @@ def maybe_install_from_env() -> bool:
 # Telemetry (lazy imports: launcher children import this module without jax)
 # ---------------------------------------------------------------------------
 
-def _record_fault(fault: Fault, site: str, dur_s: float = 0.0) -> None:
+def _record_fault(fault: Fault, site: str, dur_s: float = 0.0,
+                  tick: Optional[int] = None) -> None:
+    try:
+        from . import flight as _flight
+        _flight.record("chaos", name=f"{fault.kind}:{site}", step=tick,
+                       rank=fault.rank, t=fault.t)
+    except Exception:                                      # pragma: no cover
+        pass
     try:
         from . import metrics as _metrics
-        from . import timeline as _tl
+        _metrics.counter(
+            "bluefog_faults_injected_total",
+            "chaos faults injected, by kind").inc(kind=fault.kind)
     except Exception:                                      # pragma: no cover
         return
-    _metrics.counter(
-        "bluefog_faults_injected_total",
-        "chaos faults injected, by kind").inc(kind=fault.kind)
+    # the timeline pulls in jax at import — a fault in a jax-free launcher
+    # child must not pay (or fail) that import just to record itself
+    import sys as _sys
+    if "jax" not in _sys.modules:
+        return
+    from . import timeline as _tl
     now_us = _tl._now_us()
     _tl.record_span(f"chaos:{site}", "FAULT",
                     now_us - dur_s * 1e6, max(dur_s * 1e6, 1.0))
 
 
+def _ambient_rank() -> Optional[int]:
+    """This process's rank in a multi-process job, else None.
+
+    In the single-process SPMD simulation every rank lives here, so every
+    fault fires in-process; in a launcher-spawned multi-process job a
+    rank-targeted kill/hang/throttle must fire only in the target rank's
+    process — the bootstrap env (set by ``bfrun-tpu``) says which one we are.
+    """
+    try:
+        if int(os.environ.get("BLUEFOG_NUM_PROCESSES", "1")) <= 1:
+            return None
+        return int(os.environ.get("BLUEFOG_PROCESS_ID", "0"))
+    except ValueError:                                     # pragma: no cover
+        return None
+
+
+# Per-rank injected-delay ledger: hang/throttle sleeps attributed to their
+# target rank since the last consume.  The straggler detector subtracts
+# these from the host wall time to reconstruct per-rank step times in the
+# single-process simulation (diagnostics.observe_step_time).
+_delay_lock = threading.Lock()
+_step_delays: Dict[int, float] = {}
+
+
+def _attribute_delay(rank: Optional[int], seconds: float) -> None:
+    if rank is None:
+        rank = _ambient_rank() or 0
+    with _delay_lock:
+        _step_delays[rank] = _step_delays.get(rank, 0.0) + seconds
+
+
+def consume_step_delays() -> Dict[int, float]:
+    """Pop the per-rank injected sleep seconds accumulated since the last
+    call (``{} `` when chaos injected nothing)."""
+    with _delay_lock:
+        out = dict(_step_delays)
+        _step_delays.clear()
+    return out
+
+
 def _enact(fault: Fault, site: str, tick: int) -> None:
-    """Apply a kill/hang/throttle fault (nan is handled by the corruptors)."""
+    """Apply a kill/hang/throttle fault (nan is handled by the corruptors).
+
+    Rank-targeted faults are gated on the ambient process rank: in a
+    multi-process job only the target rank's process enacts them.
+    """
+    me = _ambient_rank()
+    if me is not None and fault.rank is not None and fault.rank != me:
+        return
     if fault.kind == "kill":
-        _record_fault(fault, site)
+        _record_fault(fault, site, tick=tick)
         raise RankKilled(fault.rank, tick, fault.code)
     if fault.kind in ("hang", "throttle"):
-        _record_fault(fault, site, dur_s=fault.t)
+        _record_fault(fault, site, dur_s=fault.t, tick=tick)
         time.sleep(fault.t)
+        _attribute_delay(fault.rank, fault.t)
 
 
 # ---------------------------------------------------------------------------

@@ -41,6 +41,7 @@ import time
 from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
+from . import flight as _flight
 from .config import logger
 
 __all__ = [
@@ -282,6 +283,12 @@ def note_cache_event(hit: bool, key: Any = None) -> None:
         counter("bluefog_compile_cache_hits_total").inc()
         return
     counter("bluefog_compile_cache_misses_total").inc()
+    # registry delta worth a flight event: a compile-cache miss is the
+    # signal postmortems align retraces/heals against
+    _flight.record("cache_miss",
+                   name=str(key[0]) if isinstance(key, tuple) and key
+                   else type(key).__name__,
+                   steady=_steady)
     if _steady:
         counter("bluefog_retrace_after_warmup_total",
                 "cache misses after a train step declared steady state").inc()
@@ -302,6 +309,7 @@ def note_retrace(detail: str = "") -> None:
     cache that grew after warmup)."""
     counter("bluefog_retrace_after_warmup_total",
             "cache misses after a train step declared steady state").inc()
+    _flight.record("retrace", detail=detail)
     logger.warning("train step re-compiled after warmup%s",
                    f" ({detail})" if detail else "")
 

@@ -18,6 +18,7 @@ from typing import Any, Optional
 
 import jax
 
+from . import flight as _flight
 from . import metrics as _metrics
 from . import timeline as _tl
 from .config import logger
@@ -60,13 +61,16 @@ def synchronize_with_watchdog(
         while not done.wait(interval):
             stalls[0] += 1
             waited = time.monotonic() - t0
+            last = _flight.last_event_description()
             logger.warning(
                 "%s has not completed after %.0f s — one or more devices/"
-                "hosts may be stalled (reference: stalled-tensor warning)",
-                name, waited)
+                "hosts may be stalled%s (reference: stalled-tensor warning)",
+                name, waited,
+                f"; last event: {last}" if last else "")
             _metrics.counter(
                 "bluefog_watchdog_stalls_total",
                 "watchdog stall-warning intervals elapsed").inc(name=name)
+            _flight.record("stall", name=name, waited_s=waited)
             now_us = _tl._now_us()
             _tl.record_span(name, "STALL",
                             now_us - interval * 1e6, interval * 1e6)
@@ -99,10 +103,17 @@ def synchronize_with_watchdog(
             _metrics.counter(
                 "bluefog_watchdog_timeouts_total",
                 "watchdog waits that hit their timeout").inc(name=name)
-            raise TimeoutError(
+            last = _flight.last_event_description()
+            msg = (
                 f"{name} did not complete within {timeout:g} s (waited "
                 f"{waited:.1f} s; {stalls[0]} stall-warning interval(s) of "
-                f"{interval:g} s elapsed) — treating the computation as hung")
+                f"{interval:g} s elapsed"
+                + (f"; last event: {last}" if last else "")
+                + ") — treating the computation as hung")
+            # flush the black box before raising: the supervisor that
+            # catches this may kill the process next
+            _flight.note_failure("watchdog_timeout", detail=msg)
+            raise TimeoutError(msg)
         if "error" in result:
             raise result["error"]
         return result["value"]

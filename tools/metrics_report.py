@@ -40,18 +40,28 @@ SERIES_GAUGES = (
 )
 
 
-def load_samples(path):
-    """All JSON lines of one host log (skips truncated trailing lines)."""
+def load_samples(path, notes=None):
+    """All JSON lines of one host log.
+
+    A truncated trailing line (the writer was killed mid-append) is
+    expected after a crash and must not sink the report — but it must not
+    vanish silently either: each undecodable line is skipped with a warning
+    on stderr and, when ``notes`` is given, a note in the report.
+    """
     out = []
     with open(path) as f:
-        for line in f:
+        for lineno, line in enumerate(f, 1):
             line = line.strip()
             if not line:
                 continue
             try:
                 out.append(json.loads(line))
-            except json.JSONDecodeError:
-                continue              # torn final line from a killed writer
+            except json.JSONDecodeError as e:
+                msg = (f"warning: {path}:{lineno}: skipping torn JSONL "
+                       f"line ({e.msg}); the writer likely died mid-append")
+                print(msg, file=sys.stderr)
+                if notes is not None:
+                    notes.append(msg)
     return out
 
 
@@ -165,15 +175,19 @@ def _summary(merged):
 
 def report_from_files(paths):
     host_samples = {}
+    load_notes = []
     for i, path in enumerate(paths):
-        samples = load_samples(path)
+        samples = load_samples(path, notes=load_notes)
         # the host id rides in each line; fall back to the file position so
         # two single-host simulations on one machine still merge as two
         host = samples[-1].get("host", i) if samples else i
         if host in host_samples:
             host = max(host_samples) + 1
         host_samples[host] = samples
-    return merge(host_samples)
+    report = merge(host_samples)
+    if load_notes:
+        report.setdefault("notes", [])[:0] = load_notes
+    return report
 
 
 def main():

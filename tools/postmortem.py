@@ -1,0 +1,293 @@
+"""Merge per-rank flight-recorder bundles into a postmortem verdict.
+
+Each rank of a failed job dumps a flight bundle (``flight_rank<r>.json``,
+written by ``bluefog_tpu.utils.flight`` — on failure, on SIGTERM from the
+launcher teardown, and at exit).  This tool is the cross-rank view: it
+aligns the per-rank event streams by step and answers the question the
+on-call person actually has — *which rank failed first, and what did the
+job look like on the way down*:
+
+    verdict        first-failed rank, failure step, failure kind/detail
+                   (hard failures — exception, non-finite, watchdog
+                   timeout, chaos kill — outrank launcher-inflicted
+                   SIGTERMs; with no failure events at all, the rank whose
+                   step counter stopped earliest is the suspect)
+    step_time      per-rank mean step time + skew + straggler verdict
+                   (from each bundle's step_end events when ranks dumped
+                   separately; from the consensus probe's piggybacked
+                   step-time samples in a single-process bundle)
+    consensus      the consensus-distance trajectory leading up to the
+                   failure, merged across ranks by step
+    topology       the gossip edges active at dump time (post-healing),
+                   from the bundles' topology blocks
+
+Torn bundles (a rank killed mid-write) are skipped with a warning, never
+fatal — same contract as ``tools/metrics_report.py`` with truncated JSONL.
+
+Run: python tools/postmortem.py --dir /path/to/flight  [--out report.json]
+     python tools/postmortem.py flight_rank0.json flight_rank1.json ...
+
+Output schema (stable, pinned by tests/test_flight.py and
+``make postmortem-smoke``):
+    {"ok": bool, "schema": str, "n_bundles": int, "ranks": [int, ...],
+     "torn": [path, ...], "verdict": {"first_failed_rank", "failure_step",
+     "failure_kind", "detail"}, "per_rank": {rank: {...}},
+     "step_time": {"mean_s", "skew_s", "straggler_rank"},
+     "consensus": [[step, max_distance], ...], "topology": {...},
+     "notes": [str, ...]}
+"""
+import argparse
+import glob
+import json
+import os
+import sys
+
+SCHEMA = "bluefog-flight-1"
+
+# hard failures outrank launcher-inflicted teardown signals: when rank 3
+# dies and the launcher SIGTERMs the survivors, every bundle carries a
+# failure-ish reason — only rank 3's is the root cause
+_HARD_KINDS = ("exception", "nonfinite", "watchdog_timeout", "kill")
+_SOFT_KINDS = ("sigterm",)
+
+
+def load_bundle(path, notes):
+    """One bundle dict, or None (with a warning note) when torn/unreadable."""
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (json.JSONDecodeError, OSError) as e:
+        msg = (f"warning: skipping torn bundle {path}: "
+               f"{type(e).__name__}: {e}")
+        print(msg, file=sys.stderr)
+        notes.append(msg)
+        return None
+
+
+def _failure_candidates(rank, bundle):
+    """(priority, step, ts, kind, detail) tuples — lower sorts earlier."""
+    out = []
+    for ev in bundle.get("events", ()):
+        kind = ev.get("kind")
+        if kind == "failure":
+            name = ev.get("name", "failure")
+            prio = 0 if name in _HARD_KINDS else 1
+            out.append((prio, ev.get("step"), ev.get("ts"),
+                        name, ev.get("detail", "")))
+        elif kind == "chaos" and str(ev.get("name", "")).startswith("kill"):
+            out.append((0, ev.get("step"), ev.get("ts"), "kill",
+                        f"chaos kill (rank {ev.get('rank')})"))
+    # a dump whose reason is a hard failure counts even if the failure
+    # event itself was evicted from the ring
+    for reason in bundle.get("reasons", ()):
+        if reason in _HARD_KINDS and not any(r[0] == 0 for r in out):
+            out.append((0, None, bundle.get("ts"), reason,
+                        f"dump reason {reason!r}"))
+        elif reason in _SOFT_KINDS:
+            out.append((1, None, bundle.get("ts"), reason,
+                        f"dump reason {reason!r}"))
+    return out
+
+
+def _per_rank_stats(bundle):
+    last_step = None
+    durs = []
+    for ev in bundle.get("events", ()):
+        if ev.get("kind") in ("step_begin", "step_end"):
+            step = ev.get("step")
+            if step is not None and (last_step is None or step > last_step):
+                last_step = step
+        if ev.get("kind") == "step_end" and ev.get("dur_s") is not None:
+            durs.append(float(ev["dur_s"]))
+    return {
+        "last_step": last_step,
+        "n_events": bundle.get("n_events", len(bundle.get("events", ()))),
+        "dropped": bundle.get("dropped", 0),
+        "reasons": list(bundle.get("reasons", ())),
+        "mean_step_s": sum(durs) / len(durs) if durs else None,
+    }
+
+
+def _consensus_trajectory(bundles):
+    """[[step, max_distance], ...] merged across ranks; probe samples
+    without a step use their 1-based sample index per rank."""
+    by_step = {}
+    for bundle in bundles.values():
+        idx = 0
+        for ev in bundle.get("events", ()):
+            if ev.get("kind") != "consensus":
+                continue
+            idx += 1
+            step = ev.get("step", idx)
+            val = ev.get("max")
+            if val is None:
+                continue
+            prev = by_step.get(step)
+            by_step[step] = max(prev, val) if prev is not None else val
+    return [[s, by_step[s]] for s in sorted(by_step)]
+
+
+def _topology_block(bundles, notes):
+    for rank in sorted(bundles):
+        topo = bundles[rank].get("topology")
+        if not topo or "size" not in topo:
+            continue
+        edges = []
+        in_nbrs = topo.get("in_neighbors")
+        if in_nbrs:
+            for dst, srcs in enumerate(in_nbrs):
+                edges.extend([int(src), dst] for src in srcs)
+        return {
+            "size": topo.get("size"),
+            "dead_ranks": topo.get("dead_ranks", []),
+            "healed": topo.get("healed", False),
+            "edges_at_failure": [list(e)
+                                 for e in sorted(map(tuple, edges))],
+        }
+    notes.append("no bundle carried a topology block")
+    return None
+
+
+def _step_time_block(bundles, per_rank):
+    """Per-rank mean step time.  Separate-process bundles each carry their
+    own step_end stream; a single-process bundle instead carries the
+    probe's piggybacked per-rank step-time samples — prefer per-bundle
+    means when more than one rank dumped, else fall back to the last
+    consensus sample's table."""
+    means = {r: s["mean_step_s"] for r, s in per_rank.items()
+             if s["mean_step_s"] is not None}
+    if len(means) < 2:
+        for rank in sorted(bundles):
+            table = None
+            for ev in bundles[rank].get("events", ()):
+                if ev.get("kind") == "consensus" and ev.get("step_times"):
+                    table = ev["step_times"]
+            if table:
+                means = {r: float(t) for r, t in enumerate(table)}
+                break
+    if not means:
+        return None
+    vals = sorted(means.values())
+    med = vals[len(vals) // 2]
+    skew = max(vals) - min(vals)
+    slowest = max(means, key=means.get)
+    straggler = (slowest
+                 if len(means) > 1 and means[slowest] > 2.0 * med and skew > 0
+                 else None)
+    return {
+        "mean_s": {str(r): means[r] for r in sorted(means)},
+        "skew_s": skew,
+        "straggler_rank": straggler,
+    }
+
+
+def analyze(bundles, notes=None, torn=()):
+    """``{rank: bundle}`` -> postmortem report dict."""
+    notes = notes if notes is not None else []
+    for rank, bundle in sorted(bundles.items()):
+        schema = bundle.get("schema")
+        if schema != SCHEMA:
+            notes.append(f"rank {rank}: unexpected schema {schema!r} "
+                         f"(this tool speaks {SCHEMA})")
+    per_rank = {r: _per_rank_stats(b) for r, b in bundles.items()}
+
+    # -- verdict ----------------------------------------------------------
+    candidates = []        # (priority, step, ts, rank, kind, detail)
+    for rank, bundle in bundles.items():
+        for prio, step, ts, kind, detail in _failure_candidates(rank, bundle):
+            candidates.append((prio, step, ts, rank, kind, detail))
+    verdict = {"first_failed_rank": None, "failure_step": None,
+               "failure_kind": None, "detail": None}
+    hard = [c for c in candidates if c[0] == 0]
+    pool = hard or candidates
+    if pool:
+        pool.sort(key=lambda c: (
+            c[0],
+            c[1] if c[1] is not None else float("inf"),
+            c[2] if c[2] is not None else float("inf")))
+        prio, step, ts, rank, kind, detail = pool[0]
+        if step is None:
+            step = per_rank[rank]["last_step"]
+        verdict = {"first_failed_rank": rank, "failure_step": step,
+                   "failure_kind": kind, "detail": detail}
+        if not hard:
+            notes.append("no hard failure recorded; verdict is based on "
+                         "teardown-signal order, which is weaker evidence")
+    else:
+        # no failure events anywhere: the rank whose step counter stopped
+        # earliest is the stall suspect (only meaningful with a spread)
+        steps = {r: s["last_step"] for r, s in per_rank.items()
+                 if s["last_step"] is not None}
+        if len(steps) >= 2 and max(steps.values()) > min(steps.values()):
+            rank = min(steps, key=steps.get)
+            verdict = {"first_failed_rank": rank,
+                       "failure_step": steps[rank],
+                       "failure_kind": "stalled",
+                       "detail": (f"rank {rank} stopped at step "
+                                  f"{steps[rank]} while others reached "
+                                  f"{max(steps.values())}")}
+
+    report = {
+        "ok": True,
+        "schema": SCHEMA,
+        "n_bundles": len(bundles),
+        "ranks": sorted(bundles),
+        "torn": list(torn),
+        "verdict": verdict,
+        "per_rank": {str(r): per_rank[r] for r in sorted(per_rank)},
+        "step_time": _step_time_block(bundles, per_rank),
+        "consensus": _consensus_trajectory(bundles),
+        "topology": _topology_block(bundles, notes),
+    }
+    if notes:
+        report["notes"] = notes
+    return report
+
+
+def report_from_files(paths):
+    notes = []
+    torn = []
+    bundles = {}
+    for i, path in enumerate(paths):
+        bundle = load_bundle(path, notes)
+        if bundle is None:
+            torn.append(path)
+            continue
+        rank = bundle.get("rank", i)
+        if rank in bundles:
+            notes.append(f"duplicate bundle for rank {rank} "
+                         f"({path}); keeping the newest by ts")
+            if bundle.get("ts", 0) <= bundles[rank].get("ts", 0):
+                continue
+        bundles[rank] = bundle
+    if not bundles:
+        return {"ok": False, "error": "no readable bundles",
+                "torn": torn, "notes": notes}
+    return analyze(bundles, notes, torn=torn)
+
+
+def main():
+    ap = argparse.ArgumentParser(
+        description="Merge per-rank flight bundles into a failure verdict.")
+    ap.add_argument("bundles", nargs="*", help="flight_rank*.json files")
+    ap.add_argument("--dir", default=None,
+                    help="directory of bundles (the launcher's --flight-dir)")
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+    paths = list(args.bundles)
+    if args.dir:
+        paths += sorted(glob.glob(os.path.join(args.dir,
+                                               "flight_rank*.json")))
+    if not paths:
+        ap.error("give bundle paths or --dir")
+    doc = report_from_files(paths)
+    print(json.dumps(doc))
+    if args.out:
+        os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+        with open(args.out, "w") as f:
+            json.dump(doc, f, indent=1)
+    sys.exit(0 if doc.get("ok") else 1)
+
+
+if __name__ == "__main__":
+    main()

@@ -6,7 +6,7 @@ PY ?= python
 
 .PHONY: test test-fast test_basic test_ops test_win_ops test_optimizer \
 	test_hier test_native test_examples verify native clean hw-watch \
-	obs-smoke chaos-smoke overlap-smoke postmortem-smoke
+	obs-smoke chaos-smoke overlap-smoke postmortem-smoke pod-smoke
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -143,3 +143,28 @@ native:
 clean:
 	rm -f bluefog_tpu/_native/libbft_native.so
 	find . -name __pycache__ -type d -exec rm -rf {} +
+
+# pod-scale smoke: the hierarchical/two-level battery (schedule compile at
+# 4096 ranks, CPU AOT cross-slice byte proofs, auto-hierarchy init) plus the
+# consensus-vs-bytes frontier artifact — schema drift in the frontier JSON
+# fails here
+pod-smoke:
+	$(PY) -m pytest tests/test_pod_scale.py -q -m "not slow"
+	$(PY) -m pytest tests/test_hierarchical.py tests/test_topology.py -q
+	$(PY) tools/gossip_bench.py --frontier --shapes 8x4,16x8 --wire bf16 \
+		--out /tmp/gossip_frontier.json
+	$(PY) -c "import json; \
+		d = json.load(open('/tmp/gossip_frontier.json')); \
+		assert d['schema'] == 'bluefog-gossip-frontier-1', d; \
+		assert len(d['shapes']) == 2, d; \
+		assert all(k in s for s in d['shapes'] for k in ('machines', \
+		'local', 'ranks', 'flat', 'hier', 'dcn_ratio', \
+		'frontier_ratio')), d; \
+		hops = [h for s in d['shapes'] for r in (s['flat'], s['hier']) \
+		for h in r['hops']]; \
+		assert all(set(h) == {'hop', 'link', 'ici_bytes', 'dcn_bytes'} \
+		for h in hops), hops; \
+		assert {h['link'] for h in d['shapes'][0]['hier']['hops']} == \
+		{'ici', 'dcn'}, d; \
+		assert all(s['frontier_ratio'] > 1 for s in d['shapes']), d; \
+		print('pod-smoke OK')"

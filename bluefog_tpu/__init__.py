@@ -40,7 +40,7 @@ from .parallel import (
     static_schedule, machine_schedule, get_context,
     machine_rank, local_rank, suspend, resume,
     set_dynamic_topology, clear_dynamic_topology, dynamic_schedules,
-    set_round_parallel, round_parallel,
+    set_round_parallel, round_parallel, set_dcn_wire, dcn_wire,
     win_create, win_free, win_put, win_accumulate, win_get,
     win_update, win_update_then_collect, win_mutex, get_win_version,
     win_associated_p,

@@ -390,7 +390,9 @@ def hierarchical_neighbor_allreduce(
     src_machine_weights=None,
     dst_machine_weights=None,
     schedule: Optional[CommSchedule] = None,
+    wire: Optional[str] = None,
     donate: bool = False,
+    concurrent: Optional[bool] = None,
 ) -> jax.Array:
     """Machine-level neighbor averaging (reference: ``mpi_ops.py:848-864``).
 
@@ -398,6 +400,12 @@ def hierarchical_neighbor_allreduce(
     gossip over the ``machine`` axis; the result is replicated within each
     machine.  ``donate=True``: average in place (see
     :func:`neighbor_allreduce`).
+
+    ``wire`` compresses the machine-axis permutes only — the DCN hop on a
+    multi-slice pod — while the intra-slice reduce stays full precision
+    (default: ``bf.set_dcn_wire`` / ``BLUEFOG_DCN_WIRE``; ``"off"`` forces
+    full width).  ``concurrent`` round-parallelizes the machine rounds
+    (default: ``bf.set_round_parallel`` / ``BLUEFOG_ROUND_PARALLEL``).
     """
     ctx = _mesh.get_context()
     _check_distributed(x, ctx.size)
@@ -405,12 +413,24 @@ def hierarchical_neighbor_allreduce(
     sched = resolve_schedule(
         self_weight, src_machine_weights, dst_machine_weights, schedule,
         size=ctx.machine_size, default_schedule=_mesh.machine_schedule)
+    # resolve the knob-backed defaults NOW so they are part of the cache key
+    # — same rule as neighbor_allreduce's concurrent: a program traced under
+    # one knob setting must not be served after the knob flips
+    if wire is None:
+        wire = ops.collectives._default_dcn_wire()
+    elif wire == "off":
+        wire = None
+    if concurrent is None:
+        concurrent = ops.collectives._default_concurrent()
     fn = _cached(
-        ("hnar", sched, ctx.mesh_2d, x.shape, x.dtype.name, donate),
+        ("hnar", sched, ctx.mesh_2d, x.shape, x.dtype.name, wire, donate,
+         concurrent),
         lambda: _shard_map_2d(
             _per_rank(partial(
                 ops.hierarchical_neighbor_allreduce, machine_sched=sched,
-                machine_axis="machine", local_axis="local")),
+                machine_axis="machine", local_axis="local",
+                wire=wire if wire is not None else "off",
+                concurrent=concurrent)),
             ctx.mesh_2d, donate=donate))
     return _dispatch("hierarchical_neighbor_allreduce", fn, x)
 

@@ -195,6 +195,50 @@ def _default_concurrent() -> bool:
         return False
 
 
+def _default_dcn_wire() -> Optional[str]:
+    """Process default for the DCN-hop wire codec of hierarchical gossip:
+    live-context knob (``bf.set_dcn_wire``), else ``BLUEFOG_DCN_WIRE``.
+
+    Only the *machine-axis* permutes of ``hierarchical_neighbor_allreduce``
+    consult this — flat gossip keeps its explicit ``wire=`` contract — so
+    setting it compresses exactly the cross-slice edges, never the
+    intra-slice reduce.  Lazy imports for the same reason as
+    :func:`_default_concurrent`.
+    """
+    try:
+        from ..parallel import context as _ctx
+        c = _ctx._context
+        if c is not None and c.dcn_wire is not None:
+            return c.dcn_wire if c.dcn_wire != "off" else None
+    except Exception:
+        pass
+    try:
+        import os
+        w = os.environ.get("BLUEFOG_DCN_WIRE", "").strip()
+        if w and w.lower() not in ("off", "none", "0"):
+            _check_wire(w)      # validate eagerly: a typo'd codec must not
+            return w            # silently fall back to full-width DCN bytes
+    except ValueError:
+        raise
+    except Exception:
+        pass
+    return None
+
+
+def _check_wire(wire: str) -> str:
+    """Validate a wire-codec spec eagerly (base + optional @B block size).
+
+    ``_parse_wire`` alone defers base validation to encode time (deep inside
+    a trace); the knob/env entry points call this instead so a typo fails at
+    the line that sets it."""
+    base, _ = _parse_wire(wire)
+    if base not in WIRE_CODECS:
+        raise ValueError(
+            f"unknown wire codec {wire!r}: pass one of {WIRE_CODECS} "
+            "(optionally with an @B block-size suffix for int8/fp8)")
+    return wire
+
+
 def _round_sends(x: jax.Array, sched: CommSchedule, idx) -> list:
     """Per-round send values (dst-weighting applies the sender-side scale)."""
     sends = []
@@ -467,6 +511,8 @@ def hierarchical_neighbor_allreduce(
     *,
     machine_axis: Axis = "machine",
     local_axis: Axis = "local",
+    wire: Optional[str] = None,
+    concurrent: Optional[bool] = None,
 ) -> jax.Array:
     """Machine-level neighbor averaging on a 2-D (machine x local) mesh.
 
@@ -476,6 +522,20 @@ def hierarchical_neighbor_allreduce(
     already leaves the machine average replicated, the machine-level gossip
     rides the cross-machine axis (DCN on multi-slice), and the trailing
     broadcast is implicit.
+
+    ``wire`` compresses the *machine-axis* permutes only — exactly the bytes
+    that cross the thin DCN links on a multi-slice pod — while the
+    intra-slice ``pmean`` (ICI, wire-speed) always reduces at full
+    precision.  ``None`` resolves to the process default
+    (``bf.set_dcn_wire`` / ``BLUEFOG_DCN_WIRE``); pass ``"off"`` to force
+    full-width DCN bytes.  ``concurrent`` emits the machine rounds as one
+    concurrent permute group (same resolution chain as the flat op:
+    ``bf.set_round_parallel`` / ``BLUEFOG_ROUND_PARALLEL``).
     """
+    if wire is None:
+        wire = _default_dcn_wire()
+    elif wire == "off":
+        wire = None
     machine_avg = lax.pmean(x, local_axis)
-    return neighbor_allreduce(machine_avg, machine_sched, axis=machine_axis)
+    return neighbor_allreduce(machine_avg, machine_sched, axis=machine_axis,
+                              wire=wire, concurrent=concurrent)

@@ -120,16 +120,28 @@ def hierarchical_communicator(
     local_axis: Axis = "local",
     fuse: bool = True,
     wire: Optional[str] = None,
+    concurrent: Optional[bool] = None,
 ) -> Communicator:
     """Machine-level neighbor averaging on the 2-D mesh (reference:
     ``DistributedHierarchicalNeighborAllreduceOptimizer``).
 
     ``wire`` compresses the machine-level gossip — exactly the edges that
     ride DCN on a multi-slice deployment, where compression pays most; the
-    intra-machine pmean (ICI) stays full precision.
+    intra-machine pmean (ICI) stays full precision.  ``None`` resolves to
+    the process DCN-wire default (``bf.set_dcn_wire`` / ``BLUEFOG_DCN_WIRE``)
+    once, here at factory time — the traced program is pinned to the knob
+    value the communicator was built under, so a later knob flip cannot
+    silently change an already-compiled step (retrace sentinel stays 0).
+    ``"off"`` forces full width.  ``concurrent`` round-parallelizes the
+    machine rounds (forwarded to :func:`bluefog_tpu.ops.neighbor_allreduce`;
+    None = context/env default).
     """
     if (machine_schedule is None) == (machine_schedules is None):
         raise ValueError("pass exactly one of machine_schedule / machine_schedules")
+    if wire is None:
+        wire = ops.collectives._default_dcn_wire()
+    elif wire == "off":
+        wire = None
 
     def comm(params, step):
         def leaf(x):
@@ -137,10 +149,11 @@ def hierarchical_communicator(
             xm = lax.pmean(x, local_axis)
             if machine_schedule is not None:
                 return ops.neighbor_allreduce(xm, machine_schedule,
-                                              axis=machine_axis, wire=w)
+                                              axis=machine_axis, wire=w,
+                                              concurrent=concurrent)
             branches = [
                 partial(ops.neighbor_allreduce, sched=s, axis=machine_axis,
-                        wire=w)
+                        wire=w, concurrent=concurrent)
                 for s in machine_schedules
             ]
             return lax.switch(step % len(machine_schedules), branches, xm)
@@ -1211,6 +1224,7 @@ def _comm_from_type(communication_type: str, kw):
     sched = kw.pop("schedule", None)
     scheds = kw.pop("schedules", None)
     wire = kw.pop("wire", None)
+    concurrent = kw.pop("concurrent", None)
     if communication_type == "neighbor_allreduce":
         if sched is None and scheds is None:
             # an installed dynamic topology (bf.set_dynamic_topology) takes
@@ -1219,21 +1233,23 @@ def _comm_from_type(communication_type: str, kw):
             scheds = _mesh.get_context().dynamic_schedules
             if scheds is None:
                 sched = _mesh.static_schedule()
-        comm = neighbor_communicator(sched, scheds, wire=wire)
+        comm = neighbor_communicator(sched, scheds, wire=wire,
+                                     concurrent=concurrent)
     elif communication_type == "hierarchical_neighbor_allreduce":
         if sched is None and scheds is None:
             sched = _mesh.machine_schedule()
-        comm = hierarchical_communicator(sched, scheds, wire=wire)
+        comm = hierarchical_communicator(sched, scheds, wire=wire,
+                                         concurrent=concurrent)
         kw.setdefault("axes", ("machine", "local"))
     elif communication_type in ("allreduce", "empty"):
         if sched is not None or scheds is not None:
             raise TypeError(
                 f"communication_type {communication_type!r} does not take a "
                 "schedule; dynamic topologies require neighbor_allreduce")
-        if wire is not None:
+        if wire is not None or concurrent is not None:
             raise TypeError(
-                f"wire compression applies to gossip, not "
-                f"communication_type {communication_type!r}")
+                f"wire compression / round-parallel emission apply to "
+                f"gossip, not communication_type {communication_type!r}")
         comm = (allreduce_communicator() if communication_type == "allreduce"
                 else empty_communicator())
     else:

@@ -31,6 +31,8 @@ from .context import (
     dynamic_schedules,
     set_round_parallel,
     round_parallel,
+    set_dcn_wire,
+    dcn_wire,
 )
 
 __all__ = [
@@ -45,6 +47,7 @@ __all__ = [
     "machine_rank", "local_rank", "suspend", "resume",
     "set_dynamic_topology", "clear_dynamic_topology", "dynamic_schedules",
     "set_round_parallel", "round_parallel",
+    "set_dcn_wire", "dcn_wire",
 ]
 
 from .windows import (

@@ -46,6 +46,12 @@ class BlueFogTpuContext:
     # process default for round-parallel gossip emission (None = defer to
     # BLUEFOG_ROUND_PARALLEL; per-call concurrent= overrides both)
     round_parallel: Optional[bool] = None
+    # process default for the DCN-hop wire codec of hierarchical gossip
+    # (None = defer to BLUEFOG_DCN_WIRE; "off" forces full width)
+    dcn_wire: Optional[str] = None
+    # how the machine grouping was derived ("auto" = from the device mesh /
+    # slice_index at init; None = manual nodes_per_machine / set_machine_topology)
+    hierarchical: Optional[str] = None
     _sched: Optional[CommSchedule] = None
     _machine_sched: Optional[CommSchedule] = None
 
@@ -149,6 +155,7 @@ def init(
     devices: Optional[List] = None,
     platform: Optional[str] = None,
     nodes_per_machine: Optional[int] = None,
+    hierarchical: Optional[str] = None,
 ) -> BlueFogTpuContext:
     """Initialize the context (reference: ``bf.init``, ``basics.py:49-70``).
 
@@ -164,6 +171,16 @@ def init(
         ``jax.local_device_count()`` when multi-process, else the device count
         (single host = one machine).  The reference's
         ``BLUEFOG_NODES_PER_MACHINE`` virtual-machine split maps here.
+      hierarchical: ``"auto"`` derives the two-level structure from the real
+        device mesh instead of requiring manual ``set_machine_topology``:
+        devices are grouped by TPU ``slice_index`` when present (reordered so
+        each slice's chips are contiguous on the rank axis, making the
+        ``machine`` mesh axis coincide with the DCN boundary), else by
+        process locality, else by ``nodes_per_machine``; the machine-level
+        topology is then auto-installed as weighted ``ExponentialTwoGraph``
+        over the slice leaders.  ``None`` defers to the ``BLUEFOG_HIERARCHICAL``
+        env flag; ``"off"`` disables.  See
+        ``docs/PERFORMANCE.md#pod-scale-hierarchical-gossip``.
     """
     global _context
     from ..utils.config import setup_logging, env_int
@@ -208,8 +225,21 @@ def init(
             devices = _torus_order(devices)
     devs = np.asarray(devices, dtype=object)
     n = len(devs)
+    if hierarchical is None:
+        from ..utils.config import env_flag
+        hierarchical = "auto" if env_flag("BLUEFOG_HIERARCHICAL", False) else None
+    elif hierarchical in ("off", False):
+        hierarchical = None
+    elif hierarchical is True:
+        hierarchical = "auto"
+    if hierarchical not in (None, "auto"):
+        raise ValueError(
+            f"hierarchical must be 'auto' or 'off', got {hierarchical!r}")
     if nodes_per_machine is None:
         nodes_per_machine = env_int("BLUEFOG_NODES_PER_MACHINE")
+    if hierarchical == "auto":
+        ordered, nodes_per_machine = _auto_hierarchy(list(devs), nodes_per_machine)
+        devs = np.asarray(ordered, dtype=object)
     if nodes_per_machine is None:
         nodes_per_machine = jax.local_device_count() if jax.process_count() > 1 else n
     maybe_start_from_env()
@@ -234,9 +264,51 @@ def init(
     ctx.topology = _check_topology(topo, n)
     ctx.topology_weighted = is_weighted
 
+    ctx.hierarchical = hierarchical
+    if hierarchical == "auto" and ctx.machine_size > 1:
+        # the two-level family's default cross-slice graph: log2(M) leader
+        # out-edges, weighted — cross-slice bytes/step scale with this
+        # degree, not the rank count (the pod-scale AOT tests pin it)
+        ctx.machine_topology = topo_util.ExponentialTwoGraph(ctx.machine_size)
+        ctx.machine_topology_weighted = True
+
     with _lock:
         _context = ctx
     return ctx
+
+
+def _auto_hierarchy(devices: List, nodes_per_machine: Optional[int]):
+    """Derive the (ordered devices, nodes_per_machine) two-level grouping.
+
+    Preference order: TPU ``slice_index`` (the real ICI/DCN boundary on a
+    multi-slice pod — devices are stably reordered so each slice's chips are
+    contiguous on the rank axis, which is what makes the 2-D mesh's
+    ``machine`` axis the DCN axis), then process locality (one machine per
+    host), then an explicit ``nodes_per_machine``.  With no detectable
+    structure every rank is its own machine: hierarchical gossip degenerates
+    to flat gossip instead of a silent wrong grouping.
+    """
+    slice_ids = [getattr(d, "slice_index", None) for d in devices]
+    distinct = {s for s in slice_ids if s is not None}
+    if len(distinct) > 1 and all(s is not None for s in slice_ids):
+        order = sorted(range(len(devices)), key=lambda i: (slice_ids[i], i))
+        ordered = [devices[i] for i in order]
+        counts = {s: slice_ids.count(s) for s in distinct}
+        sizes = set(counts.values())
+        if len(sizes) != 1:
+            raise ValueError(
+                f"hierarchical='auto' needs equal-sized slices, got {counts}")
+        derived = sizes.pop()
+        if nodes_per_machine is not None and nodes_per_machine != derived:
+            raise ValueError(
+                f"nodes_per_machine={nodes_per_machine} contradicts the "
+                f"device mesh ({derived} chips per slice)")
+        return ordered, derived
+    if nodes_per_machine is not None:
+        return devices, nodes_per_machine
+    if jax.process_count() > 1:
+        return devices, jax.local_device_count()
+    return devices, 1
 
 
 def _torus_order(devices):
@@ -449,6 +521,29 @@ def set_round_parallel(value: Optional[bool]) -> None:
 def round_parallel() -> Optional[bool]:
     """The context's round-parallel default (see :func:`set_round_parallel`)."""
     return get_context().round_parallel
+
+
+def set_dcn_wire(value: Optional[str]) -> None:
+    """Set the process default wire codec for the DCN hop of hierarchical
+    gossip (``"bf16"``/``"int8"``/``"fp8"``, optionally ``"@B"``-blocked).
+
+    Applies only to the machine-axis permutes of
+    ``hierarchical_neighbor_allreduce`` / ``hierarchical_communicator`` —
+    the cross-slice edges — never the intra-slice reduce, which stays full
+    precision.  ``"off"`` forces full-width DCN bytes, ``None`` defers to
+    the ``BLUEFOG_DCN_WIRE`` env var.  A per-call ``wire=`` always wins.
+    Like ``set_round_parallel``, flip it before warmup: it is part of the
+    traced program (and of the program-cache key).
+    """
+    if value is not None and value != "off":
+        from ..ops.collectives import _check_wire
+        _check_wire(value)
+    get_context().dcn_wire = value
+
+
+def dcn_wire() -> Optional[str]:
+    """The context's DCN-wire default (see :func:`set_dcn_wire`)."""
+    return get_context().dcn_wire
 
 
 def static_schedule() -> CommSchedule:

@@ -112,6 +112,34 @@ def rounds_edge_disjoint(sched: "CommSchedule") -> bool:
     return True
 
 
+def columns_stochastic(sched: "CommSchedule", atol: float = 1e-6) -> bool:
+    """True iff every rank's received mass (self + in-edges) sums to 1.
+
+    Column-stochasticity of the effective mixing matrix is what makes
+    neighbor averaging a *consensus* operator (the all-equal state is a fixed
+    point and the global mean is preserved for doubly-stochastic weights).
+    The compilers preserve it from the topology by construction — including
+    the composed two-level family (``topology.TwoLevelGraph``), where the
+    Kronecker product of column-stochastic levels is column-stochastic —
+    and healing folds dead-rank mass into self-loops to keep it; this check
+    is the tested witness of that guarantee, the column counterpart of
+    :func:`rounds_edge_disjoint`.
+    """
+    mass = sched.self_weight.astype(np.float64).copy()
+    for r in range(sched.num_rounds):
+        w = sched.recv_weight[r].astype(np.float64)
+        if sched.uses_dst_weighting:
+            # the sender scales before the permute: the mass that actually
+            # arrives is recv_weight * send_scale[sender]
+            src = sched.recv_src[r]
+            w = w * np.where(
+                src >= 0,
+                sched.send_scale[r][np.clip(src, 0, None)].astype(np.float64),
+                0.0)
+        mass += w
+    return bool(np.allclose(mass, 1.0, atol=atol, rtol=0.0))
+
+
 # ---------------------------------------------------------------------------
 # Compiled schedule
 # ---------------------------------------------------------------------------
@@ -247,11 +275,15 @@ def compile_topology(
     self_weight = np.zeros(size, dtype=np.float32)
     edge_weights: Dict[Edge, float] = {}
     if weighted:
+        # read weights off the dense matrix computed once above —
+        # GetRecvWeights rebuilds W per call, which turns pod-scale compiles
+        # (4096 ranks) into an O(n^3) stall
         for dst in range(size):
-            sw, nbr = topo_util.GetRecvWeights(topo, dst)
-            self_weight[dst] = sw
-            for src, w in nbr.items():
-                edge_weights[(src, dst)] = w
+            for src in topo.predecessors(dst):
+                if src == dst:
+                    self_weight[dst] = float(W[dst, dst])
+                else:
+                    edge_weights[(src, dst)] = float(W[src, dst])
     else:
         for dst in range(size):
             # graph in-neighbors, not nonzero weights: an explicit zero-weight
@@ -316,17 +348,28 @@ def dynamic_schedule_period(generator_factory, size: int, probe: int = 256) -> i
     ``generator_factory(rank)`` must return the reference-style iterator
     yielding ``([send_ranks], [recv_ranks])``.  All shipped generators are
     periodic with a small period (lcm of per-rank degrees / log2 terms).
+
+    Each step's *global* edge set is signatured once (a tuple over all
+    ranks' yields) and the period is detected on the signature sequence:
+    O(size * probe) generator pulls plus O(probe^2) integer-hash compares,
+    instead of the naive per-candidate-period rescan of every rank's raw
+    tuples — O(size * probe^2) elementwise comparisons, a multi-second
+    init stall at pod sizes (4096 ranks x probe 256).  The winning
+    candidate is confirmed against the raw signatures, so a hash collision
+    can never shorten the detected period.
     """
-    seqs = []
-    for rank in range(size):
-        gen = generator_factory(rank)
-        seqs.append([next(gen) for _ in range(probe)])
+    step_sig: List[Tuple] = []
+    gens = [generator_factory(rank) for rank in range(size)]
+    for _ in range(probe):
+        step_sig.append(tuple(
+            (tuple(send), tuple(recv))
+            for send, recv in (next(gen) for gen in gens)))
+    step_hash = [hash(sig) for sig in step_sig]
     for period in range(1, probe // 2 + 1):
-        if all(
-            seqs[r][t] == seqs[r][t % period]
-            for r in range(size) for t in range(probe)
-        ):
-            return period
+        if all(step_hash[t] == step_hash[t % period] for t in range(probe)):
+            # hashes matched — confirm on the raw signatures once
+            if all(step_sig[t] == step_sig[t % period] for t in range(probe)):
+                return period
     raise ValueError(f"no period <= {probe // 2} detected; pass schedules explicitly")
 
 

@@ -52,6 +52,9 @@ __all__ = [
     "StarGraph",
     "RingGraph",
     "FullyConnectedGraph",
+    "TwoLevelGraph",
+    "compose_two_level",
+    "spectral_gap",
     "GetDynamicOnePeerSendRecvRanks",
     "GetExp2DynamicSendRecvMachineRanks",
     "GetInnerOuterRingDynamicSendRecvRanks",
@@ -277,6 +280,138 @@ def FullyConnectedGraph(size: int) -> nx.DiGraph:
     """Complete graph, uniform 1/size weights (reference :284-303)."""
     assert size > 0
     return _circulant(size, np.full(size, 1.0 / size))
+
+
+# ---------------------------------------------------------------------------
+# Two-level (hierarchical) topology family and spectral utilities
+# ---------------------------------------------------------------------------
+
+_INTER_FAMILY = {
+    "exp2": ExponentialTwoGraph,
+    "ring": RingGraph,
+    "full": FullyConnectedGraph,
+}
+
+_INTRA_FAMILY = {
+    "dense": lambda size: _graph_from_matrix(np.full((size, size), 1.0 / size)),
+    "exp2": ExponentialTwoGraph,
+    "ring": RingGraph,
+}
+
+
+def compose_two_level(machine_topo, local_topo) -> np.ndarray:
+    """Effective mixing matrix of one hierarchical gossip step.
+
+    ``hierarchical_neighbor_allreduce`` first mixes within each machine
+    (``W_local`` over the ICI axis), then gossips the per-machine value across
+    machines (``W_machine`` over the DCN axis) with the same local index on
+    every machine exchanging in lockstep.  With rank ``= machine * L + local``
+    the composition is exactly the Kronecker product::
+
+        W_eff[(m, l0), (m', l)] = W_machine[m, m'] * W_local[l0, l]
+
+    i.e. ``kron(W_machine, W_local)``.  The default intra-machine ``pmean``
+    is ``W_local = J/L`` (uniform averaging), whose spectrum {1, 0, ...}
+    makes ``spectral_gap(W_eff) == spectral_gap(W_machine)``: the composed
+    consensus rate is governed entirely by the cross-machine graph while the
+    per-step DCN bytes are governed by its degree — the frontier
+    ``tools/gossip_bench.py --frontier`` grades.
+
+    Args accept ``nx.DiGraph`` or dense ``[n, n]`` matrices; an ``int`` for
+    ``local_topo`` means uniform ``J/L`` (the pmean path).
+    """
+    Wm = to_weight_matrix(machine_topo) if isinstance(machine_topo, nx.DiGraph) \
+        else np.asarray(machine_topo, dtype=float)
+    if isinstance(local_topo, (int, np.integer)):
+        L = int(local_topo)
+        assert L > 0
+        Wl = np.full((L, L), 1.0 / L)
+    elif isinstance(local_topo, nx.DiGraph):
+        Wl = to_weight_matrix(local_topo)
+    else:
+        Wl = np.asarray(local_topo, dtype=float)
+    return np.kron(Wm, Wl)
+
+
+def TwoLevelGraph(
+    num_machines: int,
+    local_size: int,
+    intra: str = "dense",
+    inter: str = "exp2",
+) -> nx.DiGraph:
+    """Composed two-level topology over ``num_machines * local_size`` ranks.
+
+    The pod-scale family from the reference's hierarchical operators
+    (``mpi_controller.cc:452-507``): a cheap high-bandwidth graph *inside*
+    each machine/slice (ICI) composed with a sparse gossip graph *across*
+    machines (DCN).  ``intra``: ``"dense"`` (uniform all-to-all average, the
+    ``pmean`` the hierarchical op executes), ``"exp2"`` or ``"ring"``.
+    ``inter``: ``"exp2"`` (default — log2(M) out-edges per machine),
+    ``"ring"`` or ``"full"``.  The returned graph's weight matrix is
+    :func:`compose_two_level` of the two levels, so
+    :func:`spectral_gap` / :func:`bluefog_tpu.schedule.compile_topology`
+    treat it like any flat topology.
+    """
+    assert num_machines > 0 and local_size > 0
+    if inter not in _INTER_FAMILY:
+        raise ValueError(f"unknown inter-machine family {inter!r}: "
+                         f"one of {sorted(_INTER_FAMILY)}")
+    if intra not in _INTRA_FAMILY:
+        raise ValueError(f"unknown intra-machine family {intra!r}: "
+                         f"one of {sorted(_INTRA_FAMILY)}")
+    Wm = (np.ones((1, 1)) if num_machines == 1
+          else to_weight_matrix(_INTER_FAMILY[inter](num_machines)))
+    Wl = (np.ones((1, 1)) if local_size == 1
+          else to_weight_matrix(_INTRA_FAMILY[intra](local_size)))
+    return _graph_from_matrix(compose_two_level(Wm, Wl))
+
+
+def _circulant_row(W: np.ndarray, atol: float = 1e-12) -> Optional[np.ndarray]:
+    """First row of ``W`` if every row i is ``row0`` rotated right by i."""
+    n = W.shape[0]
+    row0 = W[0]
+    shifts = (np.arange(n)[:, None] - np.arange(n)[None, :]) % n
+    # circulant iff W[i, j] == row0[(j - i) % n] for all i, j
+    if np.allclose(W, row0[(-shifts) % n], atol=atol, rtol=0.0):
+        return row0
+    return None
+
+
+def spectral_gap(topo, atol: float = 1e-6) -> float:
+    """``1 - |lambda_2|`` of a mixing matrix — the consensus contraction rate.
+
+    Accepts a topology graph or a dense ``[n, n]`` matrix ``W[src, dst]``.
+    Verifies column-stochasticity first (every receiver's weights — self plus
+    in-edges — must sum to 1, the invariant
+    :func:`bluefog_tpu.schedule.columns_stochastic` witnesses on compiled
+    schedules) and raises ``ValueError`` otherwise: a non-stochastic matrix
+    has no consensus fixed point, so its "gap" would be meaningless.
+
+    Circulant matrices (all the ring/exponential families) take an exact
+    FFT fast path — the eigenvalues of a circulant are the DFT of its first
+    row — so flat pod-scale graphs (4096+ ranks) grade in milliseconds;
+    everything else falls back to a dense eigendecomposition.
+    """
+    W = to_weight_matrix(topo) if isinstance(topo, nx.DiGraph) \
+        else np.asarray(topo, dtype=float)
+    if W.ndim != 2 or W.shape[0] != W.shape[1]:
+        raise ValueError(f"mixing matrix must be square, got shape {W.shape}")
+    n = W.shape[0]
+    col_sums = W.sum(axis=0)
+    if not np.allclose(col_sums, 1.0, atol=atol, rtol=0.0):
+        worst = int(np.abs(col_sums - 1.0).argmax())
+        raise ValueError(
+            f"mixing matrix is not column-stochastic: column {worst} sums to "
+            f"{col_sums[worst]:.6f} (mass arriving at each rank must be 1)")
+    if n == 1:
+        return 1.0
+    row0 = _circulant_row(W)
+    if row0 is not None:
+        moduli = np.abs(np.fft.fft(row0))
+    else:
+        moduli = np.abs(np.linalg.eigvals(W))
+    moduli = np.sort(moduli)[::-1]
+    return float(1.0 - moduli[1])
 
 
 # ---------------------------------------------------------------------------

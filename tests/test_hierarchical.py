@@ -5,6 +5,7 @@ virtual machines (there via BLUEFOG_NODES_PER_MACHINE, here via
 nodes_per_machine reshaping the mesh).
 """
 import numpy as np
+import jax
 import jax.numpy as jnp
 import pytest
 
@@ -111,3 +112,242 @@ def test_hierarchical_communicator_int8_wire_matches_uncompressed_closely():
 
     exact, wired = run(None), run("int8")
     assert np.abs(exact - wired).max() <= np.abs(x).max() / 254.0 * 4
+
+
+# ---------------------------------------------------------------------------
+# hierarchical="auto": mesh-derived two-level structure (no manual
+# set_machine_topology)
+# ---------------------------------------------------------------------------
+
+def test_init_hierarchical_auto_installs_machine_topology(cpu_devices):
+    """Auto mode derives the machine topology from the grouping: weighted
+    Exp2 over the slice leaders, ready for hierarchical ops immediately."""
+    bf.init(devices=cpu_devices, nodes_per_machine=L, hierarchical="auto")
+    assert bf.get_context().hierarchical == "auto"
+    assert bf.machine_size() == M
+    assert tu.IsTopologyEquivalent(
+        bf.load_machine_topology(), tu.ExponentialTwoGraph(M))
+    assert bf.is_machine_topology_weighted()
+
+    x = jnp.broadcast_to(
+        jnp.arange(N, dtype=jnp.float32)[:, None], (N, DIM))
+    out = bf.hierarchical_neighbor_allreduce(x)
+    mavg = np.arange(N, dtype=np.float64).reshape(M, L).mean(axis=1)
+    W = tu.to_weight_matrix(tu.ExponentialTwoGraph(M))
+    expected_m = W.T @ mavg
+    for r in range(N):
+        np.testing.assert_allclose(
+            np.asarray(out[r]), np.full(DIM, expected_m[r // L]), rtol=1e-5)
+
+
+def test_init_hierarchical_auto_effective_matrix_is_two_level():
+    """One auto-hierarchical gossip step == the composed two-level matrix
+    (kron of the machine graph with uniform intra-slice averaging)."""
+    ctx = bf.get_context()
+    bf.init(devices=list(ctx.devices), nodes_per_machine=L, hierarchical="auto")
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.normal(size=(N, DIM)), jnp.float32)
+    out = bf.hierarchical_neighbor_allreduce(x)
+    W_eff = tu.to_weight_matrix(tu.TwoLevelGraph(M, L))
+    np.testing.assert_allclose(
+        np.asarray(out), W_eff.T @ np.asarray(x), rtol=1e-5, atol=1e-6)
+
+
+class _FakeSliceDevice:
+    def __init__(self, did, slice_index):
+        self.id = did
+        self.slice_index = slice_index
+
+    def __repr__(self):
+        return f"dev({self.id}, slice={self.slice_index})"
+
+
+def test_auto_hierarchy_groups_by_slice_index():
+    """slice_index wins over everything: devices are reordered so each
+    slice is contiguous on the rank axis and nodes_per_machine is derived."""
+    from bluefog_tpu.parallel.context import _auto_hierarchy
+    devs = [_FakeSliceDevice(i, slice_index=i % 4) for i in range(8)]
+    ordered, npm = _auto_hierarchy(devs, None)
+    assert npm == 2
+    assert [d.slice_index for d in ordered] == [0, 0, 1, 1, 2, 2, 3, 3]
+    # stable within a slice: original enumeration order preserved
+    assert [d.id for d in ordered] == [0, 4, 1, 5, 2, 6, 3, 7]
+    # an explicit nodes_per_machine contradicting the mesh fails loudly
+    with pytest.raises(ValueError, match="contradicts"):
+        _auto_hierarchy(devs, 4)
+    # ragged slices fail loudly
+    ragged = [_FakeSliceDevice(i, slice_index=0 if i < 3 else 1)
+              for i in range(8)]
+    with pytest.raises(ValueError, match="equal-sized"):
+        _auto_hierarchy(ragged, None)
+
+
+def test_auto_hierarchy_without_structure_is_flat():
+    """No slices, single process, no nodes_per_machine: every rank is its
+    own machine (hierarchical degenerates to flat, never a wrong grouping)."""
+    from bluefog_tpu.parallel.context import _auto_hierarchy
+    devs = list(range(8))      # objects without slice_index
+    ordered, npm = _auto_hierarchy(devs, None)
+    assert ordered == devs and npm == 1
+    # explicit nodes_per_machine is honored
+    assert _auto_hierarchy(devs, 2) == (devs, 2)
+
+
+def test_init_hierarchical_rejects_bogus_mode(cpu_devices):
+    with pytest.raises(ValueError, match="hierarchical"):
+        bf.init(devices=cpu_devices, hierarchical="yes-please")
+
+
+# ---------------------------------------------------------------------------
+# DCN wire codec and round-parallel emission on the hierarchical op
+# ---------------------------------------------------------------------------
+
+def _ramp():
+    rng = np.random.default_rng(7)
+    return jnp.asarray(rng.normal(size=(N, DIM)), jnp.float32)
+
+
+def test_hierarchical_wire_bf16_close_to_exact():
+    x = _ramp()
+    exact = np.asarray(bf.hierarchical_neighbor_allreduce(x))
+    wired = np.asarray(bf.hierarchical_neighbor_allreduce(x, wire="bf16"))
+    np.testing.assert_allclose(wired, exact, rtol=1e-2, atol=1e-2)
+    assert not np.array_equal(wired, exact), \
+        "bf16 wire must actually touch the DCN payload"
+
+
+def test_hierarchical_concurrent_matches_sequential():
+    x = _ramp()
+    seq = np.asarray(bf.hierarchical_neighbor_allreduce(x, concurrent=False))
+    par = np.asarray(bf.hierarchical_neighbor_allreduce(x, concurrent=True))
+    np.testing.assert_allclose(par, seq, rtol=1e-6, atol=1e-7)
+
+
+def test_dcn_wire_knob_is_the_default_and_joins_the_cache_key():
+    """set_dcn_wire supplies the default wire; the resolved knob is part of
+    the program-cache key so flipping it cannot serve a stale program."""
+    from bluefog_tpu.parallel import context as _mesh
+    x = _ramp()
+    explicit = np.asarray(bf.hierarchical_neighbor_allreduce(x, wire="int8"))
+    bf.set_dcn_wire("int8")
+    try:
+        assert bf.dcn_wire() == "int8"
+        defaulted = np.asarray(bf.hierarchical_neighbor_allreduce(x))
+        np.testing.assert_array_equal(defaulted, explicit)
+        # per-call "off" beats the knob: matches the uncompressed program
+        bf.set_dcn_wire(None)
+        exact = np.asarray(bf.hierarchical_neighbor_allreduce(x))
+        bf.set_dcn_wire("int8")
+        off = np.asarray(bf.hierarchical_neighbor_allreduce(x, wire="off"))
+        np.testing.assert_array_equal(off, exact)
+        assert not np.array_equal(defaulted, exact)
+    finally:
+        bf.set_dcn_wire(None)
+    with pytest.raises(ValueError, match="wire codec"):
+        bf.set_dcn_wire("float7")
+
+
+def test_dcn_wire_env_default(monkeypatch):
+    """BLUEFOG_DCN_WIRE is the env-level default under the context knob."""
+    from bluefog_tpu.ops import collectives as co
+    monkeypatch.setenv("BLUEFOG_DCN_WIRE", "bf16")
+    assert co._default_dcn_wire() == "bf16"
+    monkeypatch.setenv("BLUEFOG_DCN_WIRE", "off")
+    assert co._default_dcn_wire() is None
+    monkeypatch.setenv("BLUEFOG_DCN_WIRE", "int7")
+    with pytest.raises(ValueError, match="wire codec"):
+        co._default_dcn_wire()
+    monkeypatch.delenv("BLUEFOG_DCN_WIRE")
+    assert co._default_dcn_wire() is None
+    bf.set_dcn_wire("fp8@64")
+    try:
+        assert co._default_dcn_wire() == "fp8@64"
+    finally:
+        bf.set_dcn_wire(None)
+
+
+# ---------------------------------------------------------------------------
+# Hierarchical + pipelined (delayed) gossip: the PR-4 overlap bar
+# ---------------------------------------------------------------------------
+
+def test_hierarchical_delayed_mixing_contracts_consensus():
+    """Hierarchical gossip composed with adapt_with_combine(delayed=True):
+    pure delayed two-level mixing x_{t+1} = W_eff^T x_{t-1} must contract
+    each parity class monotonically to the preserved mean — with donation
+    intact and the retrace sentinel at 0, the same bar the flat overlap
+    suite pins."""
+    import optax
+    from bluefog_tpu import optimizers as bfopt
+    from bluefog_tpu import diagnostics as bfdiag
+
+    def zero_grad_fn(params, batch):
+        return jnp.zeros(()), jax.tree.map(jnp.zeros_like, params)
+
+    strat = bfopt.adapt_with_combine(
+        optax.sgd(0.05),
+        bfopt.hierarchical_communicator(bf.machine_schedule(), wire=None,
+                                        concurrent=False),
+        delayed=True, axes=("machine", "local"))
+    assert strat.pipelined
+
+    rng = np.random.default_rng(5)
+    params = {"w": jnp.asarray(rng.normal(size=(N, DIM)), jnp.float32)}
+    batch = jnp.zeros((N, 1), jnp.float32)
+    state = bfopt.init_distributed(strat, params)
+    step = bfopt.make_train_step(zero_grad_fn, strat, donate=True,
+                                 overlap=True)
+
+    dists = [float(np.max(bfdiag.consensus_distance(params)))]
+    params, state, _ = step(params, state, batch)     # reshard to the mesh
+    dists.append(float(np.max(bfdiag.consensus_distance(params))))
+    old_w = params["w"]
+    params, state, _ = step(params, state, batch)
+    assert old_w.is_deleted(), "hierarchical overlap must not break donation"
+    steady = step._cache_size()
+    dists.append(float(np.max(bfdiag.consensus_distance(params))))
+    for _ in range(47):
+        params, state, _ = step(params, state, batch)
+        dists.append(float(np.max(bfdiag.consensus_distance(params))))
+    assert step._cache_size() == steady, (
+        "hierarchical overlap must not retrace in steady state")
+
+    # monotone per parity class down to the f32 noise floor (the two-level
+    # ring contracts so fast the tail is pure rounding jitter)
+    for t in range(len(dists) - 2):
+        assert dists[t + 2] <= dists[t] * (1 + 1e-6) + 1e-7, (t, dists)
+    assert dists[-1] < 1e-2 * dists[0], dists
+    np.testing.assert_allclose(
+        np.asarray(params["w"]).mean(axis=0),
+        np.asarray(
+            rng_mean := np.asarray(
+                np.random.default_rng(5).normal(size=(N, DIM))
+            ).mean(axis=0)),
+        rtol=1e-4, atol=1e-5)
+
+
+def test_hierarchical_delayed_wire_and_concurrent_still_contract():
+    """The full pod-scale configuration — delayed overlap + DCN bf16 wire +
+    round-parallel machine rounds — keeps the consensus contraction."""
+    import optax
+    from bluefog_tpu import optimizers as bfopt
+    from bluefog_tpu import diagnostics as bfdiag
+
+    def zero_grad_fn(params, batch):
+        return jnp.zeros(()), jax.tree.map(jnp.zeros_like, params)
+
+    strat = bfopt.adapt_with_combine(
+        optax.sgd(0.05),
+        bfopt.hierarchical_communicator(bf.machine_schedule(), wire="bf16",
+                                        concurrent=True),
+        delayed=True, axes=("machine", "local"))
+    rng = np.random.default_rng(11)
+    params = {"w": jnp.asarray(rng.normal(size=(N, DIM)), jnp.float32)}
+    batch = jnp.zeros((N, 1), jnp.float32)
+    state = bfopt.init_distributed(strat, params)
+    step = bfopt.make_train_step(zero_grad_fn, strat, donate=True,
+                                 overlap=True)
+    d0 = float(np.max(bfdiag.consensus_distance(params)))
+    for _ in range(30):
+        params, state, _ = step(params, state, batch)
+    d1 = float(np.max(bfdiag.consensus_distance(params)))
+    assert d1 < 0.2 * d0, (d0, d1)

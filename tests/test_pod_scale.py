@@ -10,7 +10,9 @@ the dense-graph native path above its 10k-edge threshold, and the flagship
 CTA train step AOT-lowered against real 64/256-device abstract v5e meshes
 (compiled TPU schedule: permute rounds, wire bytes, bounded compile time).
 """
+import json
 import re
+import subprocess
 import sys
 import time
 import os
@@ -31,7 +33,7 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir,
 from strategy_bench import wire_stats  # noqa: E402
 
 
-@pytest.mark.parametrize("n", [64, 256, 1024])
+@pytest.mark.parametrize("n", [64, 256, 1024, 4096])
 def test_exp2_schedule_compiles_to_degree_rounds(n):
     """Circulant decomposition at pod size: rounds == degree == log2(n),
     every round a FULL permutation (all n links busy), in bounded time."""
@@ -430,3 +432,138 @@ def test_wire_compressed_win_put_on_machine_axis():
     assert len(sends) == 2 and len(recvs) == 2, (sends, recvs)
     assert all("bf16[" in l for l in sends + recvs), (sends, recvs)
     assert not any(re.search(r"f32\[\d{4,}", l) for l in sends + recvs)
+
+
+# ---------------------------------------------------------------------------
+# Pod-scale hierarchical gossip on virtual CPU devices: the cross-slice
+# (DCN) byte budget follows the LEADER DEGREE, not the rank count.  These
+# run the lowering in a subprocess so XLA can fabricate 1024/4096 host
+# devices without disturbing this process's 8-device fixture; they read the
+# StableHLO text (pre-optimization) because the CPU backend constant-folds
+# bf16 casts away in compiled HLO.  Fast (<3s each) — intentionally NOT
+# marked slow so tier-1 keeps proving the scaling law.
+# ---------------------------------------------------------------------------
+
+_GOSSIP_AOT_PROBE = '''
+import json
+import re
+import sys
+
+sys.path.insert(0, sys.argv[1])
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from bluefog_tpu import optimizers as bfopt
+from bluefog_tpu import schedule as sch
+from bluefog_tpu import topology as tu
+
+M, L, mode = int(sys.argv[2]), int(sys.argv[3]), sys.argv[4]
+n = M * L
+devs = np.array(jax.devices())
+assert devs.size == n, (devs.size, n)
+DIM = 256
+
+if mode == "hier":
+    mesh = Mesh(devs.reshape(M, L), ("machine", "local"))
+    spec = P(("machine", "local"))
+    comm = bfopt.hierarchical_communicator(
+        sch.compile_topology(tu.ExponentialTwoGraph(M)), wire="bf16",
+        fuse=False)
+else:
+    mesh = Mesh(devs, ("rank",))
+    spec = P("rank")
+    comm = bfopt.neighbor_communicator(
+        sch.compile_topology(tu.ExponentialTwoGraph(n)), fuse=False)
+
+
+def per_rank(x):
+    return comm({"w": x[0]}, 0)["w"][None]
+
+
+fn = jax.jit(jax.shard_map(
+    per_rank, mesh=mesh, in_specs=(spec,), out_specs=spec))
+sds = jax.ShapeDtypeStruct(
+    (n, DIM), jnp.float32, sharding=NamedSharding(mesh, spec))
+txt = fn.lower(sds).as_text()
+
+lines = txt.splitlines()
+permutes = [l for l in lines if "stablehlo.collective_permute" in l]
+ty = re.compile(r"\\(tensor<((?:\\d+x)*)(bf16|f32|f64|i8|i32)>\\)")
+WIDTH = {"bf16": 2, "f32": 4, "f64": 8, "i8": 1, "i32": 4}
+dtypes, wire_bytes = set(), 0
+for l in permutes:
+    m = ty.search(l)
+    assert m, l
+    els = 1
+    for d in m.group(1).split("x"):
+        if d:
+            els *= int(d)
+    dtypes.add(m.group(2))
+    wire_bytes += els * WIDTH[m.group(2)]
+
+ar_dtype = None
+for i, l in enumerate(lines):
+    if "stablehlo.all_reduce" in l:
+        # region op: the (operand) -> result type rides the closing brace
+        for j in range(i, min(i + 40, len(lines))):
+            m = ty.search(lines[j])
+            if m and "}) : " in lines[j]:
+                ar_dtype = m.group(2)
+                break
+        break
+
+print(json.dumps({
+    "n": n, "M": M, "L": L, "mode": mode,
+    "permute_count": len(permutes),
+    "permute_dtypes": sorted(dtypes),
+    "gossip_bytes_per_chip": wire_bytes,
+    "all_reduce_dtype": ar_dtype,
+}))
+'''
+
+
+def _probe_gossip_aot(tmp_path, mode, M, L):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = tmp_path / "gossip_aot_probe.py"
+    script.write_text(_GOSSIP_AOT_PROBE)
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith("BLUEFOG_")}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={M * L}"
+    res = subprocess.run(
+        [sys.executable, str(script), repo, str(M), str(L), mode],
+        env=env, capture_output=True, text=True, timeout=300)
+    assert res.returncode == 0, res.stderr[-4000:]
+    return json.loads(res.stdout.strip().splitlines()[-1])
+
+
+def test_hierarchical_aot_cross_slice_bytes_follow_leader_degree(tmp_path):
+    """1024 ranks (32 slices x 32) and 4096 ranks (32 slices x 128): the
+    hierarchical program carries exactly degree(Exp2(32)) == 5 machine
+    permutes, all bf16 (the DCN wire codec), while the intra-slice mean
+    stays a full-precision f32 all-reduce — and the per-chip cross-slice
+    byte count is IDENTICAL at 4x the rank count."""
+    small = _probe_gossip_aot(tmp_path, "hier", 32, 32)
+    big = _probe_gossip_aot(tmp_path, "hier", 32, 128)
+    degree = int(np.log2(32))
+    for r in (small, big):
+        assert r["permute_count"] == degree, r
+        assert r["permute_dtypes"] == ["bf16"], r
+        assert r["all_reduce_dtype"] == "f32", r
+        assert r["gossip_bytes_per_chip"] == degree * 256 * 2, r
+    assert small["gossip_bytes_per_chip"] == big["gossip_bytes_per_chip"]
+
+
+def test_flat_gossip_aot_bytes_grow_with_rank_count(tmp_path):
+    """The counterpoint that makes the frontier: flat Exp2 gossip at the
+    same two sizes pays log2(n) full-width f32 permutes — its wire bytes
+    GROW with rank count where the hierarchical program's stayed flat."""
+    small = _probe_gossip_aot(tmp_path, "flat", 32, 32)
+    big = _probe_gossip_aot(tmp_path, "flat", 32, 128)
+    assert small["permute_count"] == 10, small      # log2(1024)
+    assert big["permute_count"] == 12, big          # log2(4096)
+    assert small["permute_dtypes"] == ["f32"], small
+    assert big["gossip_bytes_per_chip"] > small["gossip_bytes_per_chip"]

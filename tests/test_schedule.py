@@ -145,3 +145,131 @@ def test_compile_topology_size_one():
     topo = tu.FullyConnectedGraph(1)
     s = sch.compile_topology(topo, weighted=True)
     assert s.num_rounds == 0 and s.self_weight[0] == 1.0
+
+
+# ---------------------------------------------------------------------------
+# Column-stochasticity witness (the column counterpart of rounds_edge_disjoint)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("gen", [
+    tu.ExponentialTwoGraph, tu.RingGraph, tu.MeshGrid2DGraph, tu.StarGraph,
+    tu.FullyConnectedGraph,
+])
+@pytest.mark.parametrize("weighted", [True, False])
+def test_columns_stochastic_static(gen, weighted):
+    """Every compiled static schedule keeps each receiver's mass at 1."""
+    sched = sch.compile_topology(gen(8), weighted=weighted)
+    assert sch.columns_stochastic(sched)
+
+
+@pytest.mark.parametrize("intra,inter", [("dense", "exp2"), ("exp2", "ring")])
+def test_columns_stochastic_two_level(intra, inter):
+    """The composed two-level schedule keeps columns stochastic."""
+    sched = sch.compile_topology(
+        tu.TwoLevelGraph(4, 2, intra=intra, inter=inter), weighted=True)
+    assert sch.columns_stochastic(sched)
+    assert sch.rounds_edge_disjoint(sched)
+
+
+def test_columns_stochastic_dynamic_period():
+    """Every schedule of a compiled dynamic period passes the witness."""
+    topo = tu.ExponentialTwoGraph(8)
+    scheds = sch.compile_dynamic_schedules(
+        lambda r: tu.GetDynamicOnePeerSendRecvRanks(topo, r), 8)
+    assert scheds and all(sch.columns_stochastic(s) for s in scheds)
+
+
+def test_columns_stochastic_detects_mass_leak():
+    """A hand-built schedule that drops received mass fails the witness."""
+    bad = sch.compile_from_weights(
+        size=4,
+        self_weights=[0.5] * 4,
+        src_weights_per_rank=[{(r + 1) % 4: 0.25} for r in range(4)],
+    )
+    assert not sch.columns_stochastic(bad)
+    good = sch.compile_from_weights(
+        size=4,
+        self_weights=[0.5] * 4,
+        src_weights_per_rank=[{(r + 1) % 4: 0.5} for r in range(4)],
+    )
+    assert sch.columns_stochastic(good)
+
+
+def test_columns_stochastic_respects_send_scales():
+    """Dst-weighted schedules count the sender-side scale in arriving mass."""
+    # each rank receives from r+1 with recv weight 0.5 but the sender
+    # pre-scales by 0.5 -> only 0.25 arrives: not column-stochastic
+    scaled = sch.compile_from_weights(
+        size=4,
+        self_weights=[0.5] * 4,
+        src_weights_per_rank=[{(r + 1) % 4: 0.5} for r in range(4)],
+        dst_weights_per_rank=[{(r - 1) % 4: 0.5} for r in range(4)],
+    )
+    assert scaled.uses_dst_weighting
+    assert not sch.columns_stochastic(scaled)
+
+
+# ---------------------------------------------------------------------------
+# dynamic_schedule_period: hash-based scan equivalence
+# ---------------------------------------------------------------------------
+
+def _brute_force_period(generator_factory, size, probe=256):
+    """The pre-optimization reference implementation: per-candidate rescan
+    of every rank's raw yield tuples (O(size * probe^2))."""
+    seqs = []
+    for rank in range(size):
+        gen = generator_factory(rank)
+        seqs.append([next(gen) for _ in range(probe)])
+    for period in range(1, probe // 2 + 1):
+        if all(seqs[r][t] == seqs[r][t % period]
+               for r in range(size) for t in range(probe)):
+            return period
+    raise ValueError("no period")
+
+
+@pytest.mark.parametrize("name,size,factory", [
+    ("one-peer-exp2", 16,
+     lambda: (lambda r: tu.GetDynamicOnePeerSendRecvRanks(
+         tu.ExponentialTwoGraph(16), r))),
+    ("one-peer-ring", 12,
+     lambda: (lambda r: tu.GetDynamicOnePeerSendRecvRanks(
+         tu.RingGraph(12), r))),
+    ("machine-exp2", 8,
+     lambda: (lambda r: tu.GetExp2DynamicSendRecvMachineRanks(16, 2, 2 * r, 0))),
+    ("inner-outer-ring", 16,
+     lambda: (lambda r: tu.GetInnerOuterRingDynamicSendRecvRanks(16, 4, r))),
+    ("inner-outer-exp2", 16,
+     lambda: (lambda r: tu.GetInnerOuterExpo2DynamicSendRecvRanks(16, 4, r))),
+])
+def test_dynamic_schedule_period_equivalence(name, size, factory):
+    """The hashed scan returns exactly what the brute-force scan returned.
+
+    Timing-insensitive by design: equivalence of the *result*, for every
+    shipped generator family, is the regression contract — plus the period
+    property itself (signatures repeat at the detected period and at no
+    shorter candidate)."""
+    probe = 64
+    got = sch.dynamic_schedule_period(factory(), size, probe=probe)
+    want = _brute_force_period(factory(), size, probe=probe)
+    assert got == want, name
+
+    gens = [factory()(r) for r in range(size)]
+    sigs = [tuple((tuple(s), tuple(rv)) for s, rv in
+                  (next(g) for g in gens)) for _ in range(probe)]
+    assert all(sigs[t] == sigs[t % got] for t in range(probe))
+    for shorter in range(1, got):
+        assert not all(sigs[t] == sigs[t % shorter] for t in range(probe))
+
+
+def test_dynamic_schedule_period_no_period_raises():
+    """An aperiodic family still fails loudly, like before."""
+    def factory(rank):
+        def gen():
+            t = 0
+            while True:
+                # the recv id grows without bound: no candidate period fits
+                yield ([(rank + 1) % 8], [rank + 8 * t])
+                t += 1
+        return gen()
+    with pytest.raises(ValueError, match="no period"):
+        sch.dynamic_schedule_period(factory, 8, probe=16)

@@ -132,3 +132,102 @@ def test_infer_source_from_destination():
     srcs = tu.InferSourceFromDestinationRanks(dsts)
     assert srcs == [[2, 3], [0, 3], [0, 1], []]
     assert tu.InferDestinationFromSourceRanks(srcs) == [sorted(d) for d in dsts]
+
+
+# ---------------------------------------------------------------------------
+# Spectral gap and the two-level (hierarchical) family
+# ---------------------------------------------------------------------------
+
+SPECTRAL_CASES = [
+    ("exp2", lambda n: tu.ExponentialTwoGraph(n)),
+    ("ring", lambda n: tu.RingGraph(n)),
+    ("mesh", lambda n: tu.MeshGrid2DGraph(n)),
+    ("star", lambda n: tu.StarGraph(n)),
+    ("full", lambda n: tu.FullyConnectedGraph(n)),
+]
+
+
+def _eig_gap(W: np.ndarray) -> float:
+    """Oracle: 1 - |lambda_2| via a direct dense eigendecomposition."""
+    moduli = np.sort(np.abs(np.linalg.eigvals(W)))[::-1]
+    return float(1.0 - moduli[1])
+
+
+@pytest.mark.parametrize("name,gen", SPECTRAL_CASES)
+@pytest.mark.parametrize("size", [4, 8, 12, 16])
+def test_spectral_gap_matches_eigendecomposition(name, gen, size):
+    """spectral_gap == 1 - |lambda_2| from numpy eig for every static family."""
+    topo = gen(size)
+    got = tu.spectral_gap(topo)
+    want = _eig_gap(tu.to_weight_matrix(topo))
+    assert abs(got - want) < 1e-8, (name, size, got, want)
+    assert 0.0 <= got <= 1.0 + 1e-12
+
+
+@pytest.mark.parametrize("intra", ["dense", "exp2", "ring"])
+@pytest.mark.parametrize("inter", ["exp2", "ring", "full"])
+def test_two_level_gap_matches_eigendecomposition(intra, inter):
+    """Composed two-level matrices grade identically to the eig oracle."""
+    topo = tu.TwoLevelGraph(4, 4, intra=intra, inter=inter)
+    got = tu.spectral_gap(topo)
+    want = _eig_gap(tu.to_weight_matrix(topo))
+    assert abs(got - want) < 1e-8, (intra, inter, got, want)
+
+
+def test_two_level_is_kron_of_levels():
+    """W(TwoLevelGraph) == kron(W_machine, W_local), rank = machine*L + local."""
+    M, L = 4, 2
+    Wm = tu.to_weight_matrix(tu.ExponentialTwoGraph(M))
+    W = tu.to_weight_matrix(tu.TwoLevelGraph(M, L))
+    np.testing.assert_allclose(W, np.kron(Wm, np.full((L, L), 1.0 / L)),
+                               atol=1e-12)
+    # and compose_two_level is that product for arbitrary inputs
+    np.testing.assert_allclose(tu.compose_two_level(Wm, L), W, atol=1e-12)
+
+
+def test_two_level_dense_intra_gap_is_machine_gap():
+    """With uniform intra-slice averaging (the pmean path) the composed
+    consensus rate is exactly the cross-machine graph's: J/L contributes
+    spectrum {1, 0}, so kron cannot create a larger second eigenvalue."""
+    for M, L in [(4, 2), (8, 4), (16, 8)]:
+        got = tu.spectral_gap(tu.TwoLevelGraph(M, L))
+        want = tu.spectral_gap(tu.ExponentialTwoGraph(M))
+        assert abs(got - want) < 1e-10, (M, L, got, want)
+
+
+def test_two_level_doubly_stochastic():
+    """Kron of doubly-stochastic levels stays doubly stochastic."""
+    W = tu.to_weight_matrix(tu.TwoLevelGraph(4, 4, intra="exp2", inter="ring"))
+    np.testing.assert_allclose(W.sum(axis=0), 1.0, atol=1e-12)
+    np.testing.assert_allclose(W.sum(axis=1), 1.0, atol=1e-12)
+
+
+def test_spectral_gap_circulant_fast_path_matches_dense():
+    """The FFT fast path (circulant families) agrees with the dense
+    fallback; non-circulant matrices (star) take the dense path and a
+    deliberately perturbed-but-stochastic matrix still grades."""
+    W = tu.to_weight_matrix(tu.ExponentialTwoGraph(32))
+    assert tu._circulant_row(W) is not None
+    assert abs(tu.spectral_gap(W) - _eig_gap(W)) < 1e-8
+    Ws = tu.to_weight_matrix(tu.StarGraph(9))
+    assert tu._circulant_row(Ws) is None
+    assert abs(tu.spectral_gap(Ws) - _eig_gap(Ws)) < 1e-8
+
+
+def test_spectral_gap_rejects_non_column_stochastic():
+    W = np.array([[0.5, 0.6], [0.5, 0.6]])
+    with pytest.raises(ValueError, match="column-stochastic"):
+        tu.spectral_gap(W)
+
+
+def test_spectral_gap_edge_sizes():
+    assert tu.spectral_gap(np.ones((1, 1))) == 1.0
+    # disconnected: two isolated self-loops -> |lambda_2| = 1, gap 0
+    assert abs(tu.spectral_gap(np.eye(2))) < 1e-12
+
+
+def test_two_level_rejects_unknown_families():
+    with pytest.raises(ValueError, match="intra"):
+        tu.TwoLevelGraph(4, 2, intra="bogus")
+    with pytest.raises(ValueError, match="inter"):
+        tu.TwoLevelGraph(4, 2, inter="bogus")

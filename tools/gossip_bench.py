@@ -8,13 +8,121 @@ hardware-independent quality of the schedule compiler; the ms column is
 backend-specific (virtual CPU mesh here, ICI on TPU).
 
 Run: python tools/gossip_bench.py --virtual-cpu --params 1048576
+
+``--frontier`` switches to the pod-scale consensus-vs-bytes frontier: for
+each ``MxL`` pod shape it grades flat Exp2 gossip against the two-level
+hierarchical schedule (uniform intra-slice mean + Exp2 across slice
+leaders) on spectral gap per cross-slice (DCN) byte.  Pure host math — no
+mesh, no jit — so it runs at 32x128 (4096 chips) in milliseconds:
+    python tools/gossip_bench.py --frontier --shapes 32x32,32x128 \
+        --wire bf16 --out /tmp/frontier.json
 """
 import argparse
+import json
 import os
 import sys
 import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+_WIRE_WIDTH = {"f32": 4, "off": 4, "bf16": 2, "int8": 1, "fp8": 1}
+
+
+def _frontier(args):
+    """Consensus-vs-bytes frontier, hierarchical vs flat, per pod shape.
+
+    Model (matches the AOT-proven lowering in tests/test_pod_scale.py):
+    ranks are contiguous per slice, so a flat Exp2(n) hop of distance d
+    crosses the slice boundary for d*M of the n senders when d < L and for
+    every sender once d >= L.  The hierarchical schedule reduces
+    intra-slice over ICI at full f32 width, then runs log2(M) machine
+    permutes — every chip carries its slice's mean across the DCN hop in
+    the wire dtype (the bytes/chip are constant in rank count at fixed M).
+    Spectral gaps: flat via the circulant FFT fast path; hierarchical via
+    gap(W_machine) — exact, because dense intra-slice averaging is the
+    rank-one projector, so gap(kron(W_m, J/L)) == gap(W_m)
+    (tests/test_topology.py::test_two_level_dense_intra_gap_is_machine_gap).
+    """
+    import numpy as np
+    from bluefog_tpu import topology as tu
+
+    wire_w = _WIRE_WIDTH[args.wire]
+    payload = args.params * 4                 # full-width f32 bytes / chip
+    report = {"schema": "bluefog-gossip-frontier-1", "params": args.params,
+              "wire": args.wire, "shapes": []}
+    for spec in args.shapes.split(","):
+        m_s, l_s = spec.lower().strip().split("x")
+        M, L = int(m_s), int(l_s)
+        if M < 2 or L < 2:
+            raise SystemExit(f"--shapes wants MxL with M,L >= 2, got {spec}")
+        n = M * L
+
+        # flat Exp2(n): log2(n) full-permutation rounds, f32 on every link
+        flat_hops, flat_ici, flat_dcn = [], 0, 0
+        for k in range(int(np.log2(n))):
+            d = 1 << k
+            crossing = n if d >= L else d * M   # senders whose hop leaves
+            dcn_b = payload * crossing // n     # their slice (avg per chip)
+            ici_b = payload - dcn_b
+            flat_hops.append({"hop": f"+{d}", "link": "ici+dcn",
+                              "ici_bytes": ici_b, "dcn_bytes": dcn_b})
+            flat_ici += ici_b
+            flat_dcn += dcn_b
+        flat_gap = tu.spectral_gap(tu.ExponentialTwoGraph(n))
+
+        # hierarchical: f32 ring-allreduce intra (ICI), wire-dtype Exp2(M)
+        # permutes across slices (DCN) — every chip carries the slice mean
+        intra_b = 2 * (L - 1) * payload // L
+        hier_hops = [{"hop": "intra-mean", "link": "ici",
+                      "ici_bytes": intra_b, "dcn_bytes": 0}]
+        hier_ici, hier_dcn = intra_b, 0
+        for k in range(int(np.log2(M))):
+            dcn_b = args.params * wire_w
+            hier_hops.append({"hop": f"+{1 << k}m", "link": "dcn",
+                              "ici_bytes": 0, "dcn_bytes": dcn_b})
+            hier_dcn += dcn_b
+        hier_gap = tu.spectral_gap(tu.ExponentialTwoGraph(M))
+
+        mib = float(2 ** 20)
+        flat_row = {"topology": f"expo2({n})", "rounds": int(np.log2(n)),
+                    "spectral_gap": flat_gap, "hops": flat_hops,
+                    "ici_bytes_per_chip": flat_ici,
+                    "dcn_bytes_per_chip": flat_dcn,
+                    "gap_per_dcn_mib": flat_gap / (flat_dcn / mib)}
+        hier_row = {"topology": f"dense({L}) x expo2({M})",
+                    "rounds": 1 + int(np.log2(M)),
+                    "spectral_gap": hier_gap, "hops": hier_hops,
+                    "ici_bytes_per_chip": hier_ici,
+                    "dcn_bytes_per_chip": hier_dcn,
+                    "gap_per_dcn_mib": hier_gap / (hier_dcn / mib)}
+        report["shapes"].append({
+            "machines": M, "local": L, "ranks": n,
+            "flat": flat_row, "hier": hier_row,
+            "dcn_ratio": flat_dcn / hier_dcn,
+            "frontier_ratio": (hier_row["gap_per_dcn_mib"]
+                               / flat_row["gap_per_dcn_mib"]),
+        })
+
+    print(f"consensus-vs-bytes frontier, {args.params} f32/chip "
+          f"({payload / 2**20:.1f} MiB model), DCN wire={args.wire}:")
+    hdr = (f"{'shape':>9} {'schedule':>22} {'rounds':>7} {'gap':>7} "
+           f"{'ICI MiB':>8} {'DCN MiB':>8} {'gap/DCN-MiB':>12}")
+    print(hdr)
+    for s in report["shapes"]:
+        for tag in ("flat", "hier"):
+            r = s[tag]
+            print(f"{s['machines']}x{s['local']:<5} {r['topology']:>22} "
+                  f"{r['rounds']:>7} {r['spectral_gap']:>7.3f} "
+                  f"{r['ici_bytes_per_chip'] / 2**20:>8.2f} "
+                  f"{r['dcn_bytes_per_chip'] / 2**20:>8.2f} "
+                  f"{r['gap_per_dcn_mib']:>12.3f}")
+        print(f"{'':>9} hierarchical moves {s['dcn_ratio']:.1f}x fewer DCN "
+              f"bytes -> {s['frontier_ratio']:.1f}x contraction per DCN byte")
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=2)
+        print(f"wrote {args.out}")
+    return report
 
 
 def main():
@@ -28,7 +136,22 @@ def main():
                              "communicator: fused / unfused / empty / "
                              "allreduce (overlap + fusion cost on this "
                              "backend)")
+    parser.add_argument("--frontier", action="store_true",
+                        help="grade the hierarchical vs flat consensus-vs-"
+                             "bytes frontier at pod shapes (host math only)")
+    parser.add_argument("--shapes", default="32x32,32x128",
+                        help="comma list of MxL pod shapes for --frontier")
+    parser.add_argument("--wire", default="bf16",
+                        choices=sorted(_WIRE_WIDTH),
+                        help="DCN wire codec assumed for the hierarchical "
+                             "schedule in --frontier")
+    parser.add_argument("--out", default=None,
+                        help="write the --frontier report as JSON here")
     args = parser.parse_args()
+
+    if args.frontier:
+        _frontier(args)
+        return
 
     if args.virtual_cpu:
         flags = os.environ.get("XLA_FLAGS", "")

@@ -6,7 +6,8 @@ PY ?= python
 
 .PHONY: test test-fast test_basic test_ops test_win_ops test_optimizer \
 	test_hier test_native test_examples verify native clean hw-watch \
-	obs-smoke chaos-smoke overlap-smoke postmortem-smoke pod-smoke
+	obs-smoke chaos-smoke overlap-smoke postmortem-smoke pod-smoke \
+	autotune-smoke
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -130,6 +131,32 @@ postmortem-smoke:
 # supervisor) — the fast chaos tier; heavy chaos runs are marked `slow`
 chaos-smoke:
 	$(PY) -m pytest tests/test_chaos.py tests/test_resilience.py -q
+
+# autotune smoke: the fast autotune battery (plan determinism, rejection
+# audit, cost-model-vs-HLO byte agreement) plus the end-to-end CLI proof —
+# tune a restricted space on the virtual CPU mesh, validate the plan
+# schema, apply it, train 5 steps with donation, assert zero retraces.
+# Live-trial tests are marked `slow` and excluded here.
+autotune-smoke:
+	$(PY) -m pytest tests/test_autotune.py tests/test_hlo_bytes.py -q \
+		-m "not slow"
+	$(PY) -m bluefog_tpu.autotune --virtual-cpu --smoke --apply-steps 5 \
+		--out /tmp/autotune_plan.json
+	$(PY) -c "import json; \
+		d = json.load(open('/tmp/autotune_plan.json')); \
+		assert d['schema'] == 'bluefog-autotune-plan-1', d; \
+		assert all(k in d for k in ('plan_id', 'config', 'objective', \
+		'n_chips', 'device_kind', 'predicted', 'audit')), d; \
+		cfg = d['config']; \
+		assert all(k in cfg for k in ('algorithm', 'topology', 'wire', \
+		'weights', 'fused_k', 'delayed', 'concurrent')), cfg; \
+		p = d['predicted']; \
+		assert p['wire_bytes_per_step_per_chip'] >= 0 and \
+		p['spectral_gap'] >= 0, p; \
+		a = d['audit']; \
+		assert a['considered'] == len(a['scored']) + len(a['rejected']), a; \
+		assert all(r['reason'] for r in a['rejected']), a; \
+		print('autotune-smoke OK')"
 
 # background TPU-tunnel watcher: probes every ~10 min, runs the full
 # measurement battery unattended on the first success (tools/hw_watch.py)

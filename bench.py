@@ -466,21 +466,43 @@ def run_bench(on_accelerator: bool, probe_info: dict) -> dict:
         (loss, new_bs), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
         return loss, {"params": grads, "bs": jax.tree.map(jnp.zeros_like, new_bs)}
 
-    # neighbor-allreduce CTA strategy; BN running stats intentionally stay
-    # at init (synthetic throughput: only the optax channel is optimized)
+    # strategy: by default the neighbor-allreduce CTA baseline; with
+    # BLUEFOG_BENCH_PLAN (set by --plan) an autotune plan replays its EXACT
+    # configuration — algorithm, topology, wire, fused-k, overlap — so a
+    # banked plan's prediction can be verified by measurement.  BN running
+    # stats intentionally stay at init (synthetic throughput: only the
+    # optax channel is optimized).
     opt = optax.sgd(0.1, momentum=0.9)
-    strategy = bfopt.adapt_with_combine(
-        opt, bfopt.neighbor_communicator(bf.static_schedule()))
+    plan = None
+    plan_path = os.environ.get("BLUEFOG_BENCH_PLAN")
+    if plan_path:
+        from bluefog_tpu.autotune import load_plan
+        plan = load_plan(plan_path)
+        if int(plan.doc["n_chips"]) != n:
+            raise RuntimeError(
+                f"plan {plan.plan_id} was tuned for "
+                f"{plan.doc['n_chips']} chips but this mesh has {n}; "
+                "re-tune on this mesh (plans replay exactly or not at all)")
+        plan.apply()
+        strategy = plan.build_strategy(opt)
+        algorithm = plan.algorithm
+        step_kwargs = plan.train_step_kwargs()
+        steps_per_call = step_kwargs["steps_per_call"]
+        config_source = f"plan:{plan.plan_id}"
+    else:
+        strategy = bfopt.adapt_with_combine(
+            opt, bfopt.neighbor_communicator(bf.static_schedule()))
+        algorithm = "neighbor_cta"
+        step_kwargs = {"steps_per_call": steps_per_call,
+                       "reuse_batch": steps_per_call > 1}
 
     train_state = {"params": params, "bs": batch_stats}
     dist_params = bfopt.replicate(train_state, n)
     dist_state = bfopt.init_distributed(strategy, dist_params)
     # the fused k-step driver with donated params/opt-state: ONE executable
     # runs the whole k-step loop and updates both pytrees in place
-    step = bfopt.make_train_step(grad_fn, strategy,
-                                 steps_per_call=steps_per_call,
-                                 reuse_batch=steps_per_call > 1,
-                                 donate=True)
+    step = bfopt.make_train_step(grad_fn, strategy, donate=True,
+                                 **step_kwargs)
 
     data = (image, labels)
     # compile ONCE via the context's AOT cache and reuse the executable for
@@ -491,7 +513,8 @@ def run_bench(on_accelerator: bool, probe_info: dict) -> dict:
     try:
         from bluefog_tpu.parallel import context as bfctx
         compiled = bfctx.cached_lowering(
-            ("bench-step", n, batch, steps_per_call, image_size, num_classes),
+            ("bench-step", n, batch, steps_per_call, image_size, num_classes,
+             algorithm, plan.plan_id if plan else None),
             step, dist_params, dist_state, data)
         ca = compiled.cost_analysis()
         if isinstance(ca, (list, tuple)):
@@ -613,12 +636,7 @@ def run_bench(on_accelerator: bool, probe_info: dict) -> dict:
         metrics_summary = None
     if metrics_summary is not None:
         try:
-            import sys as _sys
-            tools_dir = os.path.join(
-                os.path.dirname(os.path.abspath(__file__)), "tools")
-            if tools_dir not in _sys.path:
-                _sys.path.insert(0, tools_dir)
-            from strategy_bench import wire_stats
+            from bluefog_tpu.utils.hlo_bytes import wire_stats
             counts, wire_b = wire_stats(compiled.as_text())
             metrics_summary["comm"] = {
                 "per_call_bytes_per_chip": int(sum(wire_b.values())),
@@ -638,11 +656,15 @@ def run_bench(on_accelerator: bool, probe_info: dict) -> dict:
             pass
 
     return {
+        "schema": "bluefog-bench-2",  # v2: strategy-aware artifacts
         "metric": "resnet50_synthetic_imgs_per_sec_per_chip",
         "value": round(per_chip, 2),
         "unit": "img/s/chip",
         "vs_baseline": round(per_chip / BASELINE_PER_GPU, 3),
         "ok": True,                   # a real measurement, not a rescue line
+        "strategy": algorithm,        # registry name (optimizers.STRATEGIES)
+        "algorithm": algorithm,
+        "plan_id": plan.plan_id if plan else None,
         "on_accelerator": on_accelerator,
         "device": device_kind,
         "n_chips": n,
@@ -782,6 +804,14 @@ def _cpu_fallback_subprocess(probe_info: dict, reason: str,
 
 
 def main():
+    # --plan <path> rides an env var so the CPU-fallback subprocess (and any
+    # other re-exec) replays the same configuration as the parent
+    if "--plan" in sys.argv:
+        idx = sys.argv.index("--plan")
+        if idx + 1 >= len(sys.argv):
+            print("bench: --plan requires a path", file=sys.stderr)
+            sys.exit(2)
+        os.environ["BLUEFOG_BENCH_PLAN"] = sys.argv[idx + 1]
     if os.environ.get("BLUEFOG_BENCH_FORCE_CPU") == "1":
         probe_info = json.loads(
             os.environ.get("BLUEFOG_BENCH_PROBE_INFO", "{}"))

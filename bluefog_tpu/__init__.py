@@ -41,6 +41,7 @@ from .parallel import (
     machine_rank, local_rank, suspend, resume,
     set_dynamic_topology, clear_dynamic_topology, dynamic_schedules,
     set_round_parallel, round_parallel, set_dcn_wire, dcn_wire,
+    apply_plan,
     win_create, win_free, win_put, win_accumulate, win_get,
     win_update, win_update_then_collect, win_mutex, get_win_version,
     win_associated_p,
@@ -58,6 +59,8 @@ from .diagnostics import (
 )
 from . import resilience
 from .resilience import mark_rank_dead, dead_ranks, guard_step
+from . import autotune as autotune_lib
+from .autotune import autotune, Plan, load_plan
 from .utils import chaos
 from .utils import flight
 

@@ -1,0 +1,23 @@
+"""Strategy autotuning: ``bf.autotune()`` (ROADMAP item 3's cap).
+
+Searches {algorithm x topology x wire codec x fused-k x delayed overlap x
+concurrent emission}, ranks candidates with three evidence tiers
+(HLO-counted wire bytes + spectral gap; banked ``docs/measured/``
+artifacts; optional live micro-trials), and returns a deterministic
+JSON-serializable :class:`Plan` that reconstructs the configured
+optimizer and context knobs anywhere — see :func:`autotune`.
+
+CLI: ``python -m bluefog_tpu.autotune --virtual-cpu --smoke``.
+"""
+from .candidates import (
+    Candidate, default_topologies, enumerate_candidates, schedule_for,
+    two_level_split,
+)
+from .plan import PLAN_SCHEMA, Plan, load_plan, plan_id_of
+from .tuner import autotune
+
+__all__ = [
+    "autotune", "Plan", "load_plan", "plan_id_of", "PLAN_SCHEMA",
+    "Candidate", "enumerate_candidates", "default_topologies",
+    "schedule_for", "two_level_split",
+]

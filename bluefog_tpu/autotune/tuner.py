@@ -1,0 +1,199 @@
+"""``bf.autotune()``: pick (algorithm, topology, wire, fused-k, overlap,
+concurrent) from a cost model + banked measurements + optional trials.
+
+Three evidence tiers feed the ranking, strongest last:
+
+1. **Analytic** (always): per-step wire bytes counted from a real compile
+   of every candidate group on the current backend
+   (:mod:`~bluefog_tpu.autotune.cost_model`) + consensus quality via
+   ``topology.spectral_gap``.  Deterministic — no clocks, no RNG.
+2. **Banked** (when ``docs/measured/`` has matching hardware artifacts):
+   strategy-aware measured seconds override the analytic pseudo-seconds
+   (:mod:`~bluefog_tpu.autotune.bank`).
+3. **Trials** (opt-in, ``trials=`` or ``BLUEFOG_AUTOTUNE_TRIALS``): the
+   top-K candidates are timed live through the cached probe programs and
+   each measurement is banked the moment it lands
+   (:mod:`~bluefog_tpu.autotune.trials`).
+
+Contract-violating combinations never reach a compile: they are filtered
+by the constructor metadata in ``optimizers.STRATEGIES`` with the
+rejection reason recorded in the plan's audit trail.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+from ..parallel import context as _mesh
+from . import bank as _bank
+from . import cost_model as _cm
+from . import trials as _trials
+from .candidates import enumerate_candidates
+from .plan import Plan, make_plan_doc
+
+
+def _default_params():
+    """Tiny two-leaf probe tree: enough structure to exercise fusion and
+    per-dtype bucketing without making ~50 group compiles expensive."""
+    import jax.numpy as jnp
+    return {"w": jnp.zeros((256, 64), jnp.float32),
+            "b": jnp.zeros((64,), jnp.float32)}
+
+
+def _default_opt_factory():
+    import optax
+    return lambda: optax.sgd(0.05, momentum=0.9)
+
+
+def autotune(
+    params=None,
+    *,
+    objective="step_time",
+    trials=0,
+    algorithms: Optional[Sequence[str]] = None,
+    topologies: Optional[Sequence[dict]] = None,
+    wires: Optional[Sequence[Optional[str]]] = None,
+    fused_k: Sequence[int] = (1, 4),
+    include_delayed: bool = True,
+    include_concurrent: bool = True,
+    opt_factory=None,
+    measured_dir: Optional[str] = None,
+    bank_trials: bool = True,
+) -> Plan:
+    """Search the strategy space and return the winning :class:`Plan`.
+
+    Args:
+      params: parameter pytree the probes compile against (defaults to a
+        tiny two-leaf tree; pass your real tree for honest byte counts).
+      objective: ``"step_time"``, ``"consensus_per_byte"``, or a weight
+        dict blending both (see ``cost_model.objective_score``).
+      trials: ``0`` (pure cost model + bank), an int K (time the top-K
+        live), or ``"auto"`` (K from ``BLUEFOG_AUTOTUNE_TRIALS``,
+        default 3).
+      algorithms / topologies / wires / fused_k / include_delayed /
+        include_concurrent: restrict the enumerated space (tests and the
+        smoke target shrink it; the default space is the full zoo).
+      opt_factory: zero-arg callable returning the inner optax optimizer
+        for probes/trials (default ``sgd(0.05, momentum=0.9)``).
+      measured_dir: override the banked-artifact directory
+        (default ``BLUEFOG_MEASURED_DIR`` or ``docs/measured``).
+      bank_trials: write trial artifacts as they land (disable in tests
+        that must not touch the bank).
+
+    Returns a deterministic plan: with ``trials=0`` the same inputs always
+    produce byte-identical plan JSON.
+    """
+    ctx = _mesh.get_context()
+    n = ctx.size
+    device_kind = ctx.devices[0].device_kind
+    on_accel = ctx.devices[0].platform != "cpu"
+    if params is None:
+        params = _default_params()
+    if opt_factory is None:
+        opt_factory = _default_opt_factory()
+    if trials == "auto":
+        trials = int(os.environ.get("BLUEFOG_AUTOTUNE_TRIALS", "3"))
+    trials = int(trials)
+
+    cands, rejected = enumerate_candidates(
+        n, algorithms=algorithms, topologies=topologies, wires=wires,
+        fused_k=fused_k, include_delayed=include_delayed,
+        include_concurrent=include_concurrent)
+    # total enumerated, fixed now: compile failures below MOVE a candidate
+    # from cands into rejected, they don't add a new one
+    considered = len(cands) + len(rejected)
+
+    # tier 1: one real compile per group -> per-step bytes for every member
+    group_bytes, group_counts, group_fail = {}, {}, {}
+    for cand in cands:
+        g = cand.compile_group
+        if g in group_bytes or g in group_fail:
+            continue
+        try:
+            counts, b = _cm.group_wire_bytes(cand, params, n, opt_factory)
+            group_bytes[g], group_counts[g] = b, counts
+        except Exception as e:                           # noqa: BLE001
+            group_fail[g] = f"compile failed: {type(e).__name__}: {e}"[:300]
+
+    scored, survivors = [], []
+    for cand in cands:
+        g = cand.compile_group
+        if g in group_fail:
+            rejected.append({"key": cand.key, "config": cand.config(),
+                             "reason": group_fail[g]})
+            continue
+        survivors.append(cand)
+        gap = _cm.consensus_gap(cand)
+        rounds = _cm.num_schedule_rounds(cand, n)
+        step_s = _cm.predicted_step_time_s(cand, group_bytes[g], rounds)
+        evidence = "analytic"
+        source = None
+        banked = _bank.banked_step_time(cand.algorithm, device_kind, n,
+                                        measured_dir, key=cand.key)
+        if banked is not None:
+            banked_s, source, exact = banked
+            # coarse (algorithm-level) evidence ranks the algorithm; the
+            # analytic model keeps ordering candidates *within* it through
+            # a 1/1000-weight residual that can never outvote a measurement
+            step_s = banked_s if exact else banked_s + step_s * 1e-3
+            evidence = "banked" if exact else "banked_coarse"
+        scored.append({"cand": cand, "bytes": group_bytes[g], "gap": gap,
+                       "rounds": rounds, "step_time_s": step_s,
+                       "evidence": evidence, "source": source})
+
+    if not scored:
+        raise RuntimeError(
+            "autotune: every candidate was rejected or failed to compile "
+            f"({len(rejected)} rejections; see the reasons)")
+
+    def score_of(e):
+        return _cm.objective_score(objective, e["step_time_s"], e["gap"],
+                                   e["bytes"])
+    scored.sort(key=lambda e: (score_of(e), e["cand"].key))
+
+    # tier 3: live-time the current top-K; measured seconds override
+    if trials > 0:
+        top = [e["cand"] for e in scored[:trials]]
+        measured = _trials.run_trials(
+            top, params, n, opt_factory, mdir=measured_dir,
+            bank=bank_trials)
+        for e in scored:
+            if e["cand"].key in measured:
+                e["step_time_s"] = measured[e["cand"].key]
+                e["evidence"] = "trial"
+                e["source"] = None
+        scored.sort(key=lambda e: (score_of(e), e["cand"].key))
+
+    best = scored[0]
+    cfg = best["cand"].config()
+    coll = {k: int(v)
+            for k, v in sorted(group_counts[best["cand"].compile_group]
+                               .items())}
+    predicted = {
+        "wire_bytes_per_step_per_chip": int(best["bytes"]),
+        "collectives": coll,
+        "spectral_gap": round(best["gap"], 9),
+        "schedule_rounds": best["rounds"],
+        "step_time_s": round(best["step_time_s"], 9),
+        "score": round(score_of(best), 12),
+        "evidence": best["evidence"],
+        "evidence_source": best["source"],
+        "backend": "accelerator" if on_accel else "cpu",
+    }
+    audit = {
+        "considered": considered,
+        "scored": [
+            {"key": e["cand"].key,
+             "wire_bytes_per_step_per_chip": int(e["bytes"]),
+             "spectral_gap": round(e["gap"], 9),
+             "step_time_s": round(e["step_time_s"], 9),
+             "score": round(score_of(e), 12),
+             "evidence": e["evidence"],
+             **({"source": e["source"]} if e["source"] else {})}
+            for e in scored],
+        "rejected": [{"key": r["key"], "reason": r["reason"]}
+                     for r in rejected],
+    }
+    return Plan(make_plan_doc(
+        config=cfg, objective=objective, n_chips=n,
+        device_kind=device_kind, predicted=predicted, audit=audit))

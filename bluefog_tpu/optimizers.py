@@ -1173,6 +1173,171 @@ def powersgd_allreduce(
 
 
 # ---------------------------------------------------------------------------
+# Strategy registry (the autotune surface)
+# ---------------------------------------------------------------------------
+
+class StrategySpec(NamedTuple):
+    """Constructor + contract metadata for one named strategy.
+
+    ``build`` takes the normalized knob set the autotuner enumerates —
+    ``(opt, *, schedule, wire, concurrent, delayed,
+    num_steps_per_communication)`` — and returns the configured
+    :class:`DecentralizedOptimizer`.  The flags describe which knobs the
+    algorithm actually responds to (so the search space can collapse the
+    indifferent axes) and ``weights`` lists the schedule weightings its
+    contract admits:
+
+    * ``"recv"`` — recv-side combine weights (``compile_topology``),
+      the standard gossip schedule.
+    * ``"push"`` — column-stochastic push weights (:func:`push_schedule`),
+      NOT dst-weighted; what push-sum-family algorithms require.
+    * ``"dst"`` — sender-side dst-weighting
+      (``compile_from_weights(..., dst_weights_per_rank=...)``); only
+      algorithms whose wire codec commutes with send scaling admit it.
+    """
+    build: Callable[..., DecentralizedOptimizer]
+    uses_schedule: bool       # gossip: wire bytes depend on the topology
+    wire_aware: bool          # accepts a wire= codec on its gossip rounds
+    concurrent_aware: bool    # accepts concurrent= round-parallel emission
+    pipelined_ok: bool        # supports delayed=True (hence overlap=True)
+    weights: Tuple[str, ...]
+
+
+def _reg_allreduce(opt, *, schedule=None, wire=None, concurrent=None,
+                   delayed=False, num_steps_per_communication=1):
+    return gradient_allreduce(opt)
+
+
+def _reg_neighbor_cta(opt, *, schedule=None, wire=None, concurrent=None,
+                      delayed=False, num_steps_per_communication=1):
+    comm = neighbor_communicator(
+        schedule if schedule is not None else _mesh.static_schedule(),
+        wire=wire, concurrent=concurrent)
+    return adapt_with_combine(
+        opt, comm, delayed=delayed,
+        num_steps_per_communication=num_steps_per_communication)
+
+
+def _reg_neighbor_atc(opt, *, schedule=None, wire=None, concurrent=None,
+                      delayed=False, num_steps_per_communication=1):
+    comm = neighbor_communicator(
+        schedule if schedule is not None else _mesh.static_schedule(),
+        wire=wire, concurrent=concurrent)
+    return adapt_then_combine(
+        opt, comm, delayed=delayed,
+        num_steps_per_communication=num_steps_per_communication)
+
+
+def _reg_exact_diffusion(opt, *, schedule=None, wire=None, concurrent=None,
+                         delayed=False, num_steps_per_communication=1):
+    comm = neighbor_communicator(
+        schedule if schedule is not None else _mesh.static_schedule(),
+        wire=wire, concurrent=concurrent)
+    return exact_diffusion(opt, comm)
+
+
+def _reg_gradient_tracking(opt, *, schedule=None, wire=None, concurrent=None,
+                           delayed=False, num_steps_per_communication=1):
+    comm = neighbor_communicator(
+        schedule if schedule is not None else _mesh.static_schedule(),
+        wire=wire, concurrent=concurrent)
+    return gradient_tracking(opt, comm)
+
+
+def _reg_push_sum(opt, *, schedule=None, wire=None, concurrent=None,
+                  delayed=False, num_steps_per_communication=1):
+    return push_sum(opt, schedule)
+
+
+def _reg_push_diging(opt, *, schedule=None, wire=None, concurrent=None,
+                     delayed=False, num_steps_per_communication=1):
+    return push_diging(opt, schedule)
+
+
+def _reg_choco(opt, *, schedule=None, wire=None, concurrent=None,
+               delayed=False, num_steps_per_communication=1):
+    return choco_gossip(opt, schedule, wire=wire if wire else "int8")
+
+
+#: Name -> :class:`StrategySpec` for every strategy the autotuner can pick.
+STRATEGIES = {
+    "allreduce": StrategySpec(
+        _reg_allreduce, uses_schedule=False, wire_aware=False,
+        concurrent_aware=False, pipelined_ok=False, weights=()),
+    "neighbor_cta": StrategySpec(
+        _reg_neighbor_cta, uses_schedule=True, wire_aware=True,
+        concurrent_aware=True, pipelined_ok=True, weights=("recv",)),
+    "neighbor_atc": StrategySpec(
+        _reg_neighbor_atc, uses_schedule=True, wire_aware=True,
+        concurrent_aware=True, pipelined_ok=False, weights=("recv",)),
+    "exact_diffusion": StrategySpec(
+        _reg_exact_diffusion, uses_schedule=True, wire_aware=True,
+        concurrent_aware=True, pipelined_ok=False, weights=("recv",)),
+    "gradient_tracking": StrategySpec(
+        _reg_gradient_tracking, uses_schedule=True, wire_aware=True,
+        concurrent_aware=True, pipelined_ok=False, weights=("recv",)),
+    "push_sum": StrategySpec(
+        _reg_push_sum, uses_schedule=True, wire_aware=False,
+        concurrent_aware=False, pipelined_ok=False, weights=("push",)),
+    "push_diging": StrategySpec(
+        _reg_push_diging, uses_schedule=True, wire_aware=False,
+        concurrent_aware=False, pipelined_ok=False, weights=("push",)),
+    "choco": StrategySpec(
+        _reg_choco, uses_schedule=True, wire_aware=True,
+        concurrent_aware=False, pipelined_ok=False,
+        weights=("recv", "dst")),
+}
+
+
+def strategy_constraint_violation(
+    name: str,
+    *,
+    schedule: Optional[CommSchedule] = None,
+    wire: Optional[str] = None,
+    delayed: bool = False,
+    num_steps_per_communication: int = 1,
+    overlap: bool = False,
+) -> Optional[str]:
+    """The reason a knob combination violates ``name``'s contract, or None.
+
+    Mirrors the raises the constructors / :func:`make_train_step` would hit
+    so the autotuner can reject candidates *before* paying for a compile and
+    record why.  Messages match the runtime errors (pinned by tests).
+    """
+    spec = STRATEGIES[name]
+    if delayed and not spec.pipelined_ok:
+        if name == "neighbor_atc":
+            return ("adapt_then_combine cannot be pipelined: its gossip "
+                    "input IS the update output. Use adapt_with_combine"
+                    "(..., delayed=True) for one-step-delayed mixing")
+        return (f"{name} has no pipelined variant: delayed=True only "
+                "applies to adapt_with_combine")
+    if delayed and num_steps_per_communication != 1:
+        return ("delayed=True requires num_steps_per_communication == 1: "
+                "the carried mixed params would be poisoned by raw params "
+                "on non-communicating steps")
+    if overlap and not (spec.pipelined_ok and delayed):
+        return ("overlap=True requires a pipelined strategy whose "
+                "comm_state carries one-step-delayed mixed params — build "
+                "one with adapt_with_combine(..., delayed=True)")
+    dst = schedule is not None and schedule.uses_dst_weighting
+    if name in ("push_sum", "push_diging") and dst:
+        return ("push_sum requires a schedule without dst-weighting "
+                "(uses_dst_weighting=False); pass dst_weight= instead"
+                if name == "push_sum" else
+                "push_diging requires column-stochastic push weights "
+                "(push_schedule), not a dst-weighted schedule")
+    if name == "choco" and dst:
+        from .ops.collectives import _parse_wire
+        w = wire if wire else "int8"
+        if _parse_wire(w)[0] not in ("int8", "fp8"):
+            return ("choco_gossip with a dst-weighted schedule "
+                    "(uses_dst_weighting=True) requires wire='int8' or "
+                    f"'fp8'; wire={w!r} does not commute with send scaling")
+    return None
+
+
+# ---------------------------------------------------------------------------
 # Reference-named factories (the familiar surface)
 # ---------------------------------------------------------------------------
 

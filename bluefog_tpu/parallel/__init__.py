@@ -30,6 +30,7 @@ from .context import (
     clear_dynamic_topology,
     dynamic_schedules,
     set_round_parallel,
+    apply_plan,
     round_parallel,
     set_dcn_wire,
     dcn_wire,
@@ -46,7 +47,7 @@ __all__ = [
     "static_schedule", "machine_schedule", "get_context",
     "machine_rank", "local_rank", "suspend", "resume",
     "set_dynamic_topology", "clear_dynamic_topology", "dynamic_schedules",
-    "set_round_parallel", "round_parallel",
+    "set_round_parallel", "round_parallel", "apply_plan",
     "set_dcn_wire", "dcn_wire",
 ]
 

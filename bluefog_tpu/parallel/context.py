@@ -546,6 +546,31 @@ def dcn_wire() -> Optional[str]:
     return get_context().dcn_wire
 
 
+def apply_plan(plan) -> bool:
+    """Apply an autotune plan's context knobs to the live process.
+
+    Accepts a :class:`bluefog_tpu.autotune.Plan` or its raw ``doc`` dict.
+    Sets the virtual topology from the plan's JSON spec (so a plan applied
+    on a different host reconstructs the identical graph and schedule key)
+    and the round-parallel emission default; per-strategy knobs (wire,
+    fused-k, delayed) live in the strategy/train-step the plan builds, not
+    in context state.  Like every topology/emission flip, apply before
+    warmup — the knobs are part of the traced program.
+    """
+    doc = plan.doc if hasattr(plan, "doc") else plan
+    cfg = doc["config"]
+    ctx = get_context()
+    if cfg.get("topology") is not None:
+        topo = topo_util.topology_from_spec(cfg["topology"])
+        if topo.number_of_nodes() != ctx.size:
+            raise ValueError(
+                f"plan was tuned for {topo.number_of_nodes()} ranks but "
+                f"this context has {ctx.size}; re-tune on this mesh")
+        set_topology(topo, is_weighted=True)
+    set_round_parallel(cfg.get("concurrent"))
+    return True
+
+
 def static_schedule() -> CommSchedule:
     return get_context().static_schedule()
 

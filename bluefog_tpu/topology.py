@@ -414,6 +414,30 @@ def spectral_gap(topo, atol: float = 1e-6) -> float:
     return float(1.0 - moduli[1])
 
 
+def topology_from_spec(spec: dict) -> nx.DiGraph:
+    """Rebuild a topology from its JSON-serializable spec dict.
+
+    The inverse of the specs :mod:`bluefog_tpu.autotune` writes into plans:
+    ``{"family": "exp2"|"ring"|"full"|"star"|"mesh2d", "size": n}`` or
+    ``{"family": "two_level", "num_machines": m, "local_size": l,
+    "intra": ..., "inter": ...}``.  Plans store the spec rather than the
+    graph so a plan applied on a different host reconstructs the identical
+    topology (same weights, same schedule key).
+    """
+    family = spec["family"]
+    if family == "two_level":
+        return TwoLevelGraph(
+            int(spec["num_machines"]), int(spec["local_size"]),
+            intra=spec.get("intra", "dense"), inter=spec.get("inter", "exp2"))
+    flat = {"exp2": ExponentialTwoGraph, "ring": RingGraph,
+            "full": FullyConnectedGraph, "star": StarGraph,
+            "mesh2d": MeshGrid2DGraph}
+    if family not in flat:
+        raise ValueError(f"unknown topology family {family!r}: one of "
+                         f"{sorted(flat) + ['two_level']}")
+    return flat[family](int(spec["size"]))
+
+
 # ---------------------------------------------------------------------------
 # Dynamic one-peer schedule generators  (reference: topology_util.py:315-554)
 #

@@ -12,9 +12,11 @@ from .utility import (
     broadcast_parameters, allreduce_parameters, broadcast_optimizer_state,
 )
 from .config import env_flag, env_int, env_float
+from .hlo_bytes import wire_stats, total_wire_bytes
 from .watchdog import synchronize_with_watchdog
 from . import chaos
 from . import flight
+from . import hlo_bytes
 
 __all__ = [
     "timeline_start_activity", "timeline_end_activity", "timeline_context",
@@ -25,6 +27,7 @@ __all__ = [
     "broadcast_parameters", "allreduce_parameters",
     "broadcast_optimizer_state",
     "env_flag", "env_int", "env_float",
+    "wire_stats", "total_wire_bytes",
     "synchronize_with_watchdog",
-    "chaos", "flight",
+    "chaos", "flight", "hlo_bytes",
 ]

@@ -118,70 +118,54 @@ def main():
             {"w": zeros, "psi": zeros},
             topology_util.MeshGrid2DGraph(n))
 
+    # The tracking-family methods are library strategies now
+    # (bluefog_tpu.optimizers.gradient_tracking / push_diging) — the inline
+    # closures this example carried predate them.  Both run through
+    # make_train_step like real training code: the strategy owns the
+    # tracker/mass state, the example only supplies the gradient.
+    import optax
+    from bluefog_tpu import optimizers as bfopt
+
+    def grad_fn(p, batch):
+        Ar, br = batch
+        r = Ar @ p["w"] - br
+        return jnp.mean(r ** 2), {"w": grad(p["w"], Ar, br)}
+
+    def run_strategy(name, strategy, label="w"):
+        step = bfopt.make_train_step(grad_fn, strategy,
+                                     steps_per_call=args.max_iters,
+                                     reuse_batch=True, donate=False)
+        dist_params = {"w": zeros}
+        dist_state = bfopt.init_distributed(strategy, dist_params)
+        dist_params, _, _ = jax.block_until_ready(
+            step(dist_params, dist_state, (A, b)))
+        w = np.asarray(dist_params["w"])
+        err = np.abs(w - w_opt).max()
+        print(f"[{name}] max |{label} - w_opt| = {err:.4e} "
+              f"after {args.max_iters} iters")
+        return err
+
     if args.method in ("all", "gradient_tracking"):
-        # x+ = Comb(x) - lr*y;  y+ = Comb(y) + grad(x+) - grad(x)
-        def gradient_tracking(c, Ar, br, sched):
-            x_new = ops.neighbor_allreduce(c["w"], sched) - lr * c["y"]
-            y_new = (ops.neighbor_allreduce(c["y"], sched)
-                     + grad(x_new, Ar, br) - c["g"])
-            return {"w": x_new, "y": y_new, "g": grad(x_new, Ar, br)}
-        g0 = bf.shard_distributed(jnp.stack(
-            [grad(jnp.zeros(D), A[r], b[r]) for r in range(n)]))
-        results["gradient_tracking"] = run(
-            "gradient_tracking", gradient_tracking,
-            {"w": zeros, "y": g0, "g": g0},
-            topology_util.ExponentialTwoGraph(n))
+        # x+ = Comb(x - lr*y);  y+ = Comb(y) + grad(x) - grad(x_prev)
+        bf.set_topology(topology_util.ExponentialTwoGraph(n),
+                        is_weighted=True)
+        results["gradient_tracking"] = run_strategy(
+            "gradient_tracking",
+            bfopt.gradient_tracking(
+                optax.sgd(lr),
+                bfopt.neighbor_communicator(bf.static_schedule())))
 
     if args.method in ("all", "push_diging"):
         # Push-DIGing (directed exp2, column-stochastic push weights):
-        # mass-preserving sends of (x, y, p); de-bias by p.
+        # mass-preserving sends of (u, p); the strategy de-biases by p and
+        # the params it returns are already z = u / p.
         topo = topology_util.ExponentialTwoGraph(n)
-        out_deg = len(topology_util.GetOutNeighbors(topo, 0))
-        scale = 1.0 / (out_deg + 1)
-        from bluefog_tpu.schedule import compile_from_weights
-        push_sched = compile_from_weights(
-            n, [scale] * n,
-            [{s: scale for s in topology_util.GetInNeighbors(topo, r)}
-             for r in range(n)])
-
-        def push_diging(c, Ar, br, sched):
-            x = c["w"] - lr * c["y"]
-            x_m = ops.neighbor_allreduce(x, push_sched)
-            p_m = ops.neighbor_allreduce(c["p"], push_sched)
-            g_new = grad(x_m / p_m, Ar, br)
-            y_m = ops.neighbor_allreduce(c["y"], push_sched) + g_new - c["g"]
-            return {"w": x_m, "y": y_m, "g": g_new, "p": p_m}
-
-        ones = bf.shard_distributed(jnp.ones((n, 1), jnp.float32))
-        g0 = bf.shard_distributed(jnp.stack(
-            [grad(jnp.zeros(D), A[r], b[r]) for r in range(n)]))
-
-        def run_pd():
-            bf.set_topology(topo)
-            sched = bf.static_schedule()
-            iters = args.max_iters
-
-            def per_rank(carry, Ar, br):
-                carry = jax.tree.map(lambda x: x[0], carry)
-                Ar, br = Ar[0], br[0]
-                def step(cc, _):
-                    return push_diging(cc, Ar, br, sched), None
-                carry, _ = lax.scan(step, carry, None, length=iters)
-                return jax.tree.map(lambda x: x[None], carry)
-
-            fn = jax.jit(jax.shard_map(
-                per_rank, mesh=mesh,
-                in_specs=(P("rank"), P("rank"), P("rank")),
-                out_specs=P("rank")))
-            out = jax.block_until_ready(
-                fn({"w": zeros, "y": g0, "g": g0, "p": ones}, A, b))
-            w = np.asarray(out["w"]) / np.asarray(out["p"])
-            err = np.abs(w - w_opt).max()
-            print(f"[push_diging] max |w/p - w_opt| = {err:.4e} "
-                  f"after {iters} iters")
-            return err
-
-        results["push_diging"] = run_pd()
+        bf.set_topology(topo)
+        results["push_diging"] = run_strategy(
+            "push_diging",
+            bfopt.push_diging(optax.sgd(lr),
+                              bfopt.push_schedule(topo, n)),
+            label="w/p")
 
     bad = {k: v for k, v in results.items() if v > 0.05}
     assert not bad, f"methods failed to converge: {bad}"

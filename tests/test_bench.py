@@ -141,6 +141,11 @@ def test_run_bench_in_process_on_virtual_mesh(monkeypatch):
     assert result["vs_baseline"] >= 0
     assert result["n_chips"] == jax.device_count()
     assert result["probe_attempts"] == 0
+    # schema-2 artifacts are strategy-aware even on the default path
+    assert result["schema"] == "bluefog-bench-2"
+    assert result["strategy"] == "neighbor_cta"
+    assert result["algorithm"] == "neighbor_cta"
+    assert result["plan_id"] is None
     # the graded artifact always reports the donation contract and embeds
     # the banked on-TPU headline next to any CPU number
     assert result["donated"] is True
@@ -168,6 +173,70 @@ def test_run_bench_fused_vs_spc1_probe(monkeypatch):
     assert cmp is not None
     assert cmp["spc1_per_step_s"] > 0 and cmp["fused_per_step_s"] > 0
     assert cmp["fused_speedup"] > 0   # tiny CPU shapes: sign only, no bound
+
+
+def _plan_doc(n_chips, fused_k=2):
+    from bluefog_tpu.autotune.plan import make_plan_doc
+    return make_plan_doc(
+        config={"algorithm": "neighbor_cta",
+                "topology": {"family": "exp2", "size": n_chips},
+                "wire": None, "weights": "recv", "fused_k": fused_k,
+                "delayed": False, "concurrent": None},
+        objective="step_time", n_chips=n_chips, device_kind="cpu",
+        predicted={}, audit={})
+
+
+def test_run_bench_replays_autotune_plan(tmp_path, monkeypatch):
+    """--plan replays the plan's EXACT configuration: algorithm, topology,
+    fused-k — and the artifact records which plan steered it."""
+    import jax
+
+    spec = importlib.util.spec_from_file_location("bench_plan", _BENCH)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    n = jax.device_count()
+    doc = _plan_doc(n)
+    plan_path = tmp_path / "plan.json"
+    with open(plan_path, "w") as f:
+        json.dump(doc, f)
+
+    monkeypatch.setenv("BLUEFOG_MEASURED_DIR", str(tmp_path))
+    monkeypatch.setenv("BLUEFOG_BENCH_BATCH", "1")
+    monkeypatch.setenv("BLUEFOG_BENCH_ITERS", "1")
+    monkeypatch.setenv("BLUEFOG_BENCH_IMAGE_SIZE", "32")
+    monkeypatch.setenv("BLUEFOG_BENCH_CLASSES", "10")
+    monkeypatch.setenv("BLUEFOG_BENCH_PLAN", str(plan_path))
+    result = mod.run_bench(False, {"probe_attempts": 0})
+    assert result["value"] > 0
+    assert result["schema"] == "bluefog-bench-2"
+    assert result["strategy"] == "neighbor_cta"
+    assert result["algorithm"] == "neighbor_cta"
+    assert result["plan_id"] == doc["plan_id"]
+    assert result["config_source"] == "plan:" + doc["plan_id"]
+    assert result["steps_per_call"] == 2          # the plan's fused_k
+    assert result["donated"] is True
+
+
+def test_run_bench_refuses_plan_for_other_mesh(tmp_path, monkeypatch):
+    """Plans replay exactly or not at all: a plan tuned for a different
+    chip count aborts the run instead of silently re-configuring."""
+    spec = importlib.util.spec_from_file_location("bench_planx", _BENCH)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    doc = _plan_doc(4)                            # conftest mesh has 8
+    plan_path = tmp_path / "plan.json"
+    with open(plan_path, "w") as f:
+        json.dump(doc, f)
+    monkeypatch.setenv("BLUEFOG_MEASURED_DIR", str(tmp_path))
+    monkeypatch.setenv("BLUEFOG_BENCH_BATCH", "1")
+    monkeypatch.setenv("BLUEFOG_BENCH_ITERS", "1")
+    monkeypatch.setenv("BLUEFOG_BENCH_IMAGE_SIZE", "32")
+    monkeypatch.setenv("BLUEFOG_BENCH_CLASSES", "10")
+    monkeypatch.setenv("BLUEFOG_BENCH_PLAN", str(plan_path))
+    with pytest.raises(RuntimeError, match="re-tune on this mesh"):
+        mod.run_bench(False, {"probe_attempts": 0})
 
 
 def test_wire_stats_per_collective_accounting():

@@ -48,6 +48,13 @@ MODULES = [
     ("bluefog_tpu.utils.watchdog", "Stall watchdog"),
     ("bluefog_tpu.resilience", "Fault tolerance (healing + rollback)"),
     ("bluefog_tpu.utils.chaos", "Deterministic fault injection"),
+    ("bluefog_tpu.autotune.tuner", "Strategy autotuner (bf.autotune)"),
+    ("bluefog_tpu.autotune.plan", "Autotune plans (persist/apply/replay)"),
+    ("bluefog_tpu.autotune.candidates", "Autotune candidate enumeration"),
+    ("bluefog_tpu.autotune.cost_model", "Autotune analytic cost model"),
+    ("bluefog_tpu.autotune.bank", "Autotune measurement bank (tier 2)"),
+    ("bluefog_tpu.autotune.trials", "Autotune live micro-trials (tier 3)"),
+    ("bluefog_tpu.utils.hlo_bytes", "Wire-byte accounting from HLO"),
 ]
 
 

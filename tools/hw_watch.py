@@ -226,6 +226,14 @@ def _battery_steps(tag: str, stage: int = 0) -> list:
                   [py, os.path.join(REPO, "tools", "tpu_validate.py"),
                    "--out", os.path.join(m, f"tpu_validate_{tag}.json")],
                   3000, None, None))
+    # strategy autotune with live micro-trials: each trial banks an
+    # autotune_trial_*.json into docs/measured/, which upgrades future
+    # (offline) autotune() calls from analytic to banked evidence for
+    # this device kind + chip count
+    steps.append(("autotune_sweep",
+                  [py, "-m", "bluefog_tpu.autotune", "--trials", "auto",
+                   "--out", os.path.join(m, f"autotune_plan_{tag}.json")],
+                  2400, None, {"PYTHONPATH": REPO}))
     if os.path.exists(ta):
         steps.append(("trace_analyze",
                       [py, ta, os.path.join(m, f"trace_{tag}"),
@@ -282,6 +290,10 @@ def _rehearsal_steps(tag: str) -> list:
          [py, os.path.join(REPO, "tools", "tpu_validate.py"),
           "--out", os.path.join(m, f"tpu_validate_{tag}.json")],
          300, None, {"JAX_PLATFORMS": "cpu"}),
+        ("autotune_sweep",
+         [py, "-m", "bluefog_tpu.autotune", "--virtual-cpu", "--smoke",
+          "--out", os.path.join(m, f"autotune_plan_{tag}.json")], 900,
+         None, {"PYTHONPATH": REPO, "BLUEFOG_COMPILE_CACHE": "off"}),
         ("trace_analyze",
          [py, os.path.join(REPO, "tools", "trace_analyze.py"),
           os.path.join(m, f"trace_{tag}"),

@@ -15,100 +15,15 @@ Run: python tools/strategy_bench.py --virtual-cpu [--json]
 """
 import argparse
 import os
-import re
 import sys
 import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
-_DT_BYTES = {"f64": 8, "u64": 8, "s64": 8, "c64": 8,
-             "f32": 4, "u32": 4, "s32": 4,
-             "bf16": 2, "f16": 2, "u16": 2, "s16": 2,
-             "u8": 1, "s8": 1, "pred": 1}
-
-# ops that move bytes across chips; -done/-update variants reuse the same
-# buffer and must not be double counted
-_COLLECTIVES = ("all-reduce", "collective-permute", "all-gather",
-                "reduce-scatter", "all-to-all")
-
-
-def _shape_bytes(token: str) -> int:
-    m = re.match(r"(\w+)\[([\d,]*)\]", token)
-    if not m or m.group(1) not in _DT_BYTES:
-        return 0
-    dims = [int(d) for d in m.group(2).split(",") if d] or [1]
-    n = 1
-    for d in dims:
-        n *= d
-    return n * _DT_BYTES[m.group(1)]
-
-
-def _group_size(line: str):
-    """Participant count from replica_groups: ``{{0,1,...}, ...}`` (explicit
-    first group) or the iota form ``[groups,size]<=[...]``."""
-    m = re.search(r"replica_groups=\{\{([\d,]+)\}", line)
-    if m:
-        return len(m.group(1).split(","))
-    m = re.search(r"replica_groups=\[\d+,(\d+)\]<=", line)
-    return int(m.group(1)) if m else None
-
-
-def wire_stats(hlo_txt: str):
-    """Per-chip wire bytes and instruction counts of cross-chip collectives
-    in a compiled (SPMD, per-partition) HLO module.
-
-    Parsed from *result* shapes (operand shapes are not always printed),
-    with accounting per collective kind — each moves a different fraction
-    of its shapes over the wire:
-
-    * ``collective-permute``: the transferred buffer(s) once — XLA's
-      combiner can merge several buffers into one permute (tuple result);
-      the ``-start`` form's result tuple is ``(in…, out…, sync flags)``,
-      so after dropping the scalar sync tokens, half the data bytes.
-    * ``all-gather``: each chip sends its 1/n shard to ``n-1`` peers, i.e.
-      ``out*(n-1)/n`` bytes (``-start`` result tuple ``(in…, out…)``:
-      second half minus first half).
-    * ``reduce-scatter``: ``in - out = out*(n-1)`` bytes leave each chip.
-    * ``all-reduce``: the reduced payload counted once (the ``-start``
-      result is the payload shape itself, not an (in, out) pair — never
-      halved; a ring implementation moves ~2x this, this column is
-      payload as the published tables state).
-    * ``all-to-all``: the buffer counted in full (each chip keeps 1/n —
-      a slight upper bound).
-    """
-    counts, bytes_ = {}, {}
-    # lazy shape span: TPU layouts carry tile annotations with parens
-    # (`f32[1024]{1,0:T(8,128)}`), so the span can't be a strict char class
-    pat = re.compile(
-        r"= (.*?) (" + "|".join(_COLLECTIVES) + r")(-start)?\(")
-    for line in hlo_txt.splitlines():
-        m = pat.search(line)
-        if not m:
-            continue
-        op, is_start = m.group(2), bool(m.group(3))
-        toks = [_shape_bytes(t)
-                for t in re.findall(r"\w+\[[\d,]*\]", m.group(1))]
-        toks = [t for t in toks if t]       # drop non-data (token[], etc.)
-        result_b = sum(toks)
-        n = _group_size(line)
-        if op == "collective-permute":
-            # drop the u32[] sync-flag scalars of the async form; a real
-            # payload buffer is never 4 bytes
-            data = [t for t in toks if t > 4]
-            payload = sum(data) // 2 if is_start else sum(data)
-        elif op in ("all-gather", "reduce-scatter") and is_start:
-            # result tuple (in…, out…): the difference is what hits the wire
-            k = len(toks) // 2
-            payload = abs(sum(toks[k:]) - sum(toks[:k]))
-        elif op == "all-gather":
-            payload = result_b * (n - 1) // n if n else result_b
-        elif op == "reduce-scatter":
-            payload = result_b * (n - 1) if n else result_b
-        else:                               # all-reduce, all-to-all
-            payload = result_b
-        counts[op] = counts.get(op, 0) + 1
-        bytes_[op] = bytes_.get(op, 0) + payload
-    return counts, bytes_
+# the counter lives in the library now (shared with the autotune cost
+# model); re-exported here so `from strategy_bench import wire_stats`
+# call sites keep working
+from bluefog_tpu.utils.hlo_bytes import wire_stats  # noqa: E402,F401
 
 
 def main():

@@ -7,7 +7,7 @@ PY ?= python
 .PHONY: test test-fast test_basic test_ops test_win_ops test_optimizer \
 	test_hier test_native test_examples verify native clean hw-watch \
 	obs-smoke chaos-smoke overlap-smoke postmortem-smoke pod-smoke \
-	autotune-smoke
+	autotune-smoke elastic-smoke
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -125,6 +125,26 @@ postmortem-smoke:
 		v = d['verdict']; \
 		assert v['first_failed_rank'] == 1 and v['failure_step'] == 30, v; \
 		print('postmortem-smoke OK')"
+
+# elastic smoke: the membership battery (admit/retire/warmup/bootstrap,
+# the interleaving invariant sweep, the kill-2-join-3 acceptance run) plus
+# a postmortem over mixed-rank-count bundles — ranks born mid-run dump a
+# grown world view; the report must note the split and keep its schema
+elastic-smoke:
+	$(PY) -m pytest tests/test_membership.py -q
+	$(PY) tools/postmortem.py \
+		tests/fixtures/flight_elastic_rank0.json \
+		tests/fixtures/flight_elastic_rank8.json \
+		--out /tmp/postmortem_elastic.json
+	$(PY) -c "import json; \
+		d = json.load(open('/tmp/postmortem_elastic.json')); \
+		assert d['ok'] and d['schema'] == 'bluefog-flight-1', d; \
+		assert all(k in d for k in ('verdict', 'per_rank', 'step_time', \
+		'consensus', 'topology')), d; \
+		t = d['topology']; \
+		assert t['size'] == 11 and t['sizes_seen'] == [8, 11], t; \
+		assert any('rank counts differ' in n for n in d['notes']), d; \
+		print('elastic-smoke OK')"
 
 # resilience smoke: deterministic fault injection + healing/rollback on
 # the virtual CPU mesh (kill->heal->contract, NaN->rollback, restart

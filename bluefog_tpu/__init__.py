@@ -58,7 +58,11 @@ from .diagnostics import (
     diagnose_consensus, consensus_distance, check_finite, detect_stragglers,
 )
 from . import resilience
-from .resilience import mark_rank_dead, dead_ranks, guard_step
+from .resilience import (
+    mark_rank_dead, dead_ranks, guard_step,
+    admit_rank, retire_rank, join_rank, advance_membership,
+    bootstrap_params, retired_ranks, live_ranks,
+)
 from . import autotune as autotune_lib
 from .autotune import autotune, Plan, load_plan
 from .utils import chaos

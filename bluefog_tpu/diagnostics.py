@@ -29,7 +29,7 @@ every step.
 """
 from __future__ import annotations
 
-from typing import Any, Dict, Optional, Sequence, Tuple
+from typing import Any, Dict, Iterable, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -396,6 +396,29 @@ def record_peer_failure(rank: int) -> None:
         _peer_failed.add(int(rank))
     _metrics.gauge("bluefog_peers_failed",
                    "ranks explicitly reported failed").set(len(_peer_failed))
+
+
+def clear_peer_failures(ranks: Optional[Iterable[int]] = None) -> None:
+    """Drop peer-failure records for ``ranks`` (all of them when None).
+
+    The re-admission / registry-reset path: a rank that was healed around
+    and later admitted back — or a ``resilience.reset()`` — must not keep
+    :func:`unhealthy_ranks` reporting it forever.  Clears the explicit
+    failure mark, the non-finite streak, and the last-bad-step record.
+    """
+    with _peer_lock:
+        if ranks is None:
+            _peer_failed.clear()
+            _peer_nonfinite_streak.clear()
+            _peer_last_bad_step.clear()
+        else:
+            for r in ranks:
+                _peer_failed.discard(int(r))
+                _peer_nonfinite_streak.pop(int(r), None)
+                _peer_last_bad_step.pop(int(r), None)
+        n_failed = len(_peer_failed)
+    _metrics.gauge("bluefog_peers_failed",
+                   "ranks explicitly reported failed").set(n_failed)
 
 
 def unhealthy_ranks(streak: int = 1) -> Tuple[int, ...]:

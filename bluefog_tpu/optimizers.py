@@ -1523,6 +1523,9 @@ class _InstrumentedStep:
         # same contract as the consensus probe below)
         if _chaos._plan is not None:
             out = _chaos.corrupt_train_output(out, self._calls)
+            # seeded membership churn (`join` faults) enacts the real
+            # elastic-join path against the step outputs
+            out = _chaos.apply_membership(out, self._calls)
         _metrics.record_step(dt, steps=self._steps_per_call,
                              donated=self._donated,
                              fused_k=self._steps_per_call,

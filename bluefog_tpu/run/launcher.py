@@ -114,6 +114,24 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--restart-backoff", type=float, default=1.0,
                    help="base seconds for the exponential restart backoff "
                         "(doubled per attempt, with deterministic jitter)")
+    p.add_argument("--elastic", action="store_true",
+                   help="elastic membership: the supervisor watches the "
+                        "scale file (see --scale) and grows the job with "
+                        "fresh-identity ranks (BLUEFOG_JOIN_COUNT set, "
+                        "flight recorder armed) or retires the "
+                        "highest-numbered ranks via SIGTERM; the running "
+                        "SPMD program absorbs the change at application "
+                        "level through resilience.admit_rank/retire_rank")
+    p.add_argument("--scale", type=int, default=None,
+                   help="without a command: signal a running --elastic "
+                        "supervisor to resize the job to N ranks (writes "
+                        "the scale file and exits). With a command: also "
+                        "record N as the initial target")
+    p.add_argument("--scale-file", default=None,
+                   help="path of the elastic scale file shared between the "
+                        "supervisor and `bfrun-tpu --scale N` (default: "
+                        "<flight-dir>/bluefog_scale, else a per-user file "
+                        "under the system temp dir)")
     p.add_argument("--no-xla-tuning", action="store_true",
                    help="do not add the recommended TPU overlap XLA flags")
     p.add_argument("--interactive", action="store_true",
@@ -316,6 +334,42 @@ def _count_restart() -> None:
         "rank respawns performed by the launcher supervisor").inc()
 
 
+def _count_membership(change: str) -> None:
+    from ..utils import metrics as _metrics
+    _metrics.counter(
+        "bluefog_membership_changes_total",
+        "membership transitions applied (dead / join / retire)"
+    ).inc(change=change)
+
+
+def _scale_file_path(args, env=None) -> str:
+    """Resolve the scale file both the supervisor and ``--scale N`` use."""
+    if args.scale_file:
+        return os.path.abspath(args.scale_file)
+    flight_dir = (env or {}).get("BLUEFOG_FLIGHT_DIR") or args.flight_dir
+    if flight_dir:
+        return os.path.join(os.path.abspath(flight_dir), "bluefog_scale")
+    import tempfile
+    uid = os.getuid() if hasattr(os, "getuid") else 0
+    return os.path.join(tempfile.gettempdir(), f"bfrun_scale_{uid}")
+
+
+def _write_scale(path: str, target: int) -> None:
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write(f"{int(target)}\n")
+    os.replace(tmp, path)      # atomic: the supervisor never reads a torn file
+
+
+def _read_scale(path: str) -> Optional[int]:
+    try:
+        with open(path) as f:
+            return int(f.read().strip())
+    except (OSError, ValueError):
+        return None
+
+
 def _report_flight_bundles(flight_dir, say) -> None:
     """After a job failure, say which per-rank flight bundles landed in the
     collection directory (the children wrote them on failure/SIGTERM) and
@@ -338,7 +392,8 @@ def _report_flight_bundles(flight_dir, say) -> None:
 
 def _supervise_procs(procs, respawn=None, *, restart_limit=0,
                      restart_backoff=1.0, labels=None,
-                     poll_interval=0.2, flight_dir=None) -> int:
+                     poll_interval=0.2, flight_dir=None,
+                     elastic=False, scale_file=None, spawn=None) -> int:
     """Supervise one Popen per rank; the shared exit path for ``-np`` and
     ``-H`` launches.
 
@@ -357,8 +412,19 @@ def _supervise_procs(procs, respawn=None, *, restart_limit=0,
     Survivors keep running throughout; the respawned child is expected to
     resume from its latest *complete* checkpoint.  Every respawn
     increments ``bluefog_rank_restarts_total``.
+
+    With ``elastic=True`` the supervisor also watches ``scale_file`` (the
+    join queue fed by ``bfrun-tpu --scale N``): a target above the current
+    slot count spawns fresh ranks via ``spawn(rank, total, join_count)`` —
+    rank ids are never reused, so a joined rank gets a fresh identity
+    (``BLUEFOG_JOIN_COUNT``) with the flight recorder armed through the
+    inherited env — and a target below it SIGTERMs the highest-numbered
+    live ranks (the graceful-retire signal: their flight handler dumps a
+    bundle on the way out).  The running ranks absorb the change at
+    application level via ``resilience.admit_rank``/``retire_rank``.
     """
     import random as _random
+    import signal as _signal
     import time as _time
 
     procs = list(procs)
@@ -366,11 +432,45 @@ def _supervise_procs(procs, respawn=None, *, restart_limit=0,
               else [f"rank {r}" for r in range(len(procs))])
     restarts = [0] * len(procs)
     done = [False] * len(procs)
+    retiring: set = set()
+    joins = 0
+    applied_target: Optional[int] = None
 
     def say(msg):
         print(f"bfrun-tpu: {msg}", file=sys.stderr, flush=True)
 
     while True:
+        if elastic and scale_file and spawn is not None:
+            target = _read_scale(scale_file)
+            if target is not None and target > 0 and target != applied_target:
+                applied_target = target
+                slots = len(procs) - len(retiring)
+                while slots < target:
+                    rank = len(procs)
+                    joins += 1
+                    say(f"elastic join: starting rank {rank} "
+                        f"(target {target})")
+                    procs.append(spawn(rank, target, joins))
+                    labels.append(f"rank {rank}")
+                    restarts.append(0)
+                    done.append(False)
+                    _count_membership("join")
+                    slots += 1
+                for rank in reversed(range(len(procs))):
+                    if slots <= target:
+                        break
+                    if rank in retiring:
+                        continue
+                    retiring.add(rank)
+                    slots -= 1
+                    _count_membership("retire")
+                    if not done[rank] and procs[rank].poll() is None:
+                        say(f"elastic retire: stopping {labels[rank]} "
+                            f"(target {target})")
+                        try:
+                            procs[rank].send_signal(_signal.SIGTERM)
+                        except OSError:       # pragma: no cover
+                            pass
         all_done = True
         for rank, p in enumerate(procs):
             if done[rank]:
@@ -378,6 +478,11 @@ def _supervise_procs(procs, respawn=None, *, restart_limit=0,
             code = p.poll()
             if code is None:
                 all_done = False
+                continue
+            if rank in retiring:
+                # asked to leave: any exit (incl. -SIGTERM) is a clean retire
+                done[rank] = True
+                say(f"{labels[rank]} retired (exit code {code})")
                 continue
             if code == 0:
                 done[rank] = True
@@ -530,12 +635,16 @@ def _interactive_cluster(args, env) -> int:
     return 0
 
 
-def _spawn_local_worker(pid, n, coordinator, env, cmd, restart_count=0):
+def _spawn_local_worker(pid, n, coordinator, env, cmd, restart_count=0,
+                        join_count=0):
     """Spawn ONE local rank of an n-process jax.distributed group.
 
     ``restart_count > 0`` marks an elastic respawn: the child sees
     ``BLUEFOG_RESTART_COUNT`` so training scripts can branch (e.g. resume
-    via ``checkpoint.restore_latest`` rather than cold-start)."""
+    via ``checkpoint.restore_latest`` rather than cold-start).
+    ``join_count > 0`` marks an elastic *join*: a fresh rank id that never
+    ran before — the child sees ``BLUEFOG_JOIN_COUNT`` so scripts bootstrap
+    via ``resilience.join_rank`` (neighbor-pull) instead of a checkpoint."""
     penv = dict(env)
     penv.update({
         "JAX_PLATFORMS": env.get("JAX_PLATFORMS", "cpu"),
@@ -545,6 +654,8 @@ def _spawn_local_worker(pid, n, coordinator, env, cmd, restart_count=0):
     })
     if restart_count:
         penv["BLUEFOG_RESTART_COUNT"] = str(restart_count)
+    if join_count:
+        penv["BLUEFOG_JOIN_COUNT"] = str(join_count)
     return subprocess.Popen(cmd, env=penv)
 
 
@@ -660,6 +771,16 @@ def main(argv: Optional[List[str]] = None) -> int:
             "topology={bf.load_topology().__class__.__name__}')")
         return subprocess.call(
             [sys.executable, "-i", "-c", bootstrap], env=env)
+    if args.scale is not None and not args.command:
+        # signalling mode: resize a running --elastic supervisor and exit
+        if args.scale < 1:
+            raise SystemExit(f"--scale needs a positive target, "
+                             f"got {args.scale}")
+        path = _scale_file_path(args)
+        _write_scale(path, args.scale)
+        print(f"bfrun-tpu: scale target {args.scale} written to {path}",
+              flush=True)
+        return 0
     if not args.command:
         build_parser().print_help()
         return 2
@@ -679,6 +800,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         # the role of `mpirun -np N` on one machine)
         n = args.num_local_processes
         coordinator = args.coordinator or "127.0.0.1:48291"
+        scale_file = _scale_file_path(args, env) if args.elastic else None
+        if args.elastic and args.scale is not None:
+            _write_scale(scale_file, args.scale)
         procs = _spawn_local_workers(n, coordinator, env, cmd)
         return _supervise_procs(
             procs,
@@ -686,7 +810,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                 rank, n, coordinator, env, cmd, restart_count=count),
             restart_limit=args.restart_limit,
             restart_backoff=args.restart_backoff,
-            flight_dir=env.get("BLUEFOG_FLIGHT_DIR"))
+            flight_dir=env.get("BLUEFOG_FLIGHT_DIR"),
+            elastic=args.elastic, scale_file=scale_file,
+            spawn=lambda rank, total, joins: _spawn_local_worker(
+                rank, total, coordinator, env, cmd, join_count=joins))
 
     if args.coordinator:
         _apply_coordinator_env(args, env)

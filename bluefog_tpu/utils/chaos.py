@@ -14,6 +14,7 @@ A plan is a seeded list of faults parsed from the ``BLUEFOG_CHAOS`` env var
     BLUEFOG_CHAOS="seed=42;kill:step=30,rank=3;nan:step=10,rank=2"
     BLUEFOG_CHAOS="hang:step=5,t=2.5;throttle:from=7,until=20,t=0.05"
     BLUEFOG_CHAOS="nan:op=neighbor_allreduce,call=3,rank=1;kill:p=0.001"
+    BLUEFOG_CHAOS="kill:step=4,rank=3;join:step=12,rank=3,warmup=2"
 
 Fault kinds (reference failure modes they emulate):
 
@@ -27,6 +28,11 @@ Fault kinds (reference failure modes they emulate):
 - ``nan``      — corrupt rank ``rank``'s payload shard to NaN (a numerics
   blow-up; the non-finite guard + rollback in ``resilience`` is the
   detector/response).
+- ``join``     — re-admit rank ``rank`` through the full elastic join
+  protocol (``resilience.chaos_join``: neighbor-pull bootstrap of the
+  step outputs, then ``admit_rank`` with ``warmup=`` ramp steps), so
+  membership churn is seeded-deterministic and testable.  No-op if the
+  rank is already live.
 
 Matching sites: faults with ``op=``/``call=`` match eager op dispatches
 (``api.py`` / ``parallel/windows.py``); all others match the train-step
@@ -54,13 +60,13 @@ __all__ = [
     "Fault", "ChaosPlan", "RankKilled",
     "install", "uninstall", "active", "current_plan",
     "maybe_install_from_env", "on_train_step", "corrupt_train_output",
-    "on_eager_op", "consume_step_delays",
+    "apply_membership", "on_eager_op", "consume_step_delays",
 ]
 
 ENV_VAR = "BLUEFOG_CHAOS"
 DEFAULT_KILL_CODE = 43
 
-_KINDS = ("kill", "hang", "throttle", "nan")
+_KINDS = ("kill", "hang", "throttle", "nan", "join")
 
 
 class RankKilled(RuntimeError):
@@ -94,6 +100,7 @@ class Fault:
     t: float = 0.0                   # hang/throttle sleep seconds
     p: Optional[float] = None        # seeded per-step probability
     code: int = DEFAULT_KILL_CODE    # kill exit code
+    warmup: int = 0                  # join entry-weight ramp steps
 
     def __post_init__(self):
         if self.kind not in _KINDS:
@@ -102,8 +109,14 @@ class Fault:
                 f"{_KINDS})")
         if self.kind in ("hang", "throttle") and self.t <= 0:
             raise ValueError(f"{self.kind} fault needs t=<seconds> > 0")
-        if self.kind == "nan" and self.rank is None:
-            raise ValueError("nan fault needs rank=<target rank>")
+        if self.kind in ("nan", "join") and self.rank is None:
+            raise ValueError(f"{self.kind} fault needs rank=<target rank>")
+        if self.kind == "join" and (self.op is not None
+                                    or self.call is not None):
+            raise ValueError(
+                "join faults match train steps, not eager ops (no op=/call=)")
+        if self.warmup < 0:
+            raise ValueError(f"warmup must be >= 0, got {self.warmup}")
         if (self.step is None and self.call is None and self.p is None
                 and self.op is None):
             raise ValueError(
@@ -126,7 +139,7 @@ class ChaosPlan:
         self._lock = threading.Lock()
 
     # -- parsing ----------------------------------------------------------
-    _INT_KEYS = ("step", "until", "call", "rank", "code")
+    _INT_KEYS = ("step", "until", "call", "rank", "code", "warmup")
     _FLOAT_KEYS = ("t", "p")
 
     @classmethod
@@ -402,7 +415,7 @@ def on_train_step(step: int) -> None:
     if plan is None:
         return
     for f in plan.match_step(step):
-        if f.kind != "nan":
+        if f.kind not in ("nan", "join"):
             _enact(f, "train_step", step)
 
 
@@ -416,6 +429,23 @@ def corrupt_train_output(out, step: int):
         if f.kind == "nan":
             _record_fault(f, "train_step")
             out = _corrupt_tree(out, f.rank)
+    return out
+
+
+def apply_membership(out, step: int):
+    """Post-dispatch train-step hook: enact ``join`` faults through the real
+    elastic-membership path (:func:`bluefog_tpu.resilience.chaos_join` —
+    neighbor-pull bootstrap of the step outputs, then admission).  Runs
+    after :func:`corrupt_train_output` so a same-step NaN hits the
+    pre-bootstrap state, exactly like production ordering."""
+    plan = _plan
+    if plan is None:
+        return out
+    for f in plan.match_step(step):
+        if f.kind == "join":
+            _record_fault(f, "train_step", tick=step)
+            from .. import resilience as _rz
+            out = _rz.chaos_join(out, f.rank, warmup_steps=f.warmup)
     return out
 
 

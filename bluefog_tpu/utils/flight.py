@@ -208,8 +208,11 @@ def _topology_block() -> Optional[dict]:
             out["in_neighbors"] = None
         res = sys.modules.get("bluefog_tpu.resilience")
         dead = tuple(res.dead_ranks()) if res is not None else ()
+        retired = tuple(res.retired_ranks()) if res is not None else ()
         out["dead_ranks"] = list(dead)
-        out["healed"] = bool(dead)
+        if retired:
+            out["retired_ranks"] = list(retired)
+        out["healed"] = bool(dead or retired)
     except Exception as e:                                # pragma: no cover
         return {"error": f"{type(e).__name__}: {e}"[:200]}
     return out

@@ -563,6 +563,9 @@ def metrics_summary() -> dict:
         "watchdog_timeouts": _counter_total(
             "bluefog_watchdog_timeouts_total"),
         "dead_ranks": _gauge_val("bluefog_dead_ranks"),
+        "membership_changes": _counter_total(
+            "bluefog_membership_changes_total"),
+        "live_ranks": _gauge_val("bluefog_live_ranks"),
     }
     if any(v for v in resilience.values()):
         out["resilience"] = resilience

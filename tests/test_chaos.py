@@ -53,11 +53,26 @@ def test_parse_full_grammar():
     assert not kill.is_op_fault and nan2.is_op_fault
 
 
+def test_parse_join_clause():
+    p = chaos.ChaosPlan.parse("seed=7;kill:step=4,rank=3;"
+                              "join:step=12,rank=3,warmup=2")
+    kill, join = p.faults
+    assert (join.kind, join.step, join.rank, join.warmup) == ("join", 12, 3, 2)
+    assert not join.is_op_fault
+    # warmup defaults to an immediate full-weight entry
+    p = chaos.ChaosPlan.parse("join:step=5,rank=1")
+    assert p.faults[0].warmup == 0
+
+
 @pytest.mark.parametrize("bad, msg", [
     ("explode:step=1", "unknown chaos fault kind"),
     ("hang:step=1", "needs t="),
     ("throttle:from=1,until=2", "needs t="),
     ("nan:step=1", "needs rank="),
+    ("join:step=1", "needs rank="),
+    ("join:op=neighbor_allreduce,rank=1", "not eager ops"),
+    ("join:call=2,rank=1", "not eager ops"),
+    ("join:step=1,rank=1,warmup=-1", "warmup must be >= 0"),
     ("kill:", "needs a trigger"),
     ("kill:p=1.5", "p must be in"),
     ("kill:step=1,zap=2", "unknown chaos parameter"),

@@ -393,3 +393,80 @@ def test_restart_resumes_from_latest_complete_checkpoint(tmp_path, capsys):
     err = capsys.readouterr().err
     assert "rank 0 exited with code 9" in err
     assert "restarting rank 0 (attempt 1/1)" in err
+
+
+def test_scale_signalling_mode(tmp_path, capsys):
+    """`bfrun-tpu --scale N` (no command) writes the scale file a running
+    --elastic supervisor watches, and exits 0."""
+    scale = tmp_path / "scale"
+    code = launcher.main(["--scale", "3", "--scale-file", str(scale)])
+    assert code == 0
+    assert scale.read_text().strip() == "3"
+    out = capsys.readouterr().out
+    assert f"scale target 3 written to {scale}" in out
+    with pytest.raises(SystemExit, match="positive"):
+        launcher.main(["--scale", "0", "--scale-file", str(scale)])
+
+
+def test_elastic_join_spawns_fresh_rank(tmp_path, capsys):
+    """--elastic: a scale target above the slot count spawns a fresh rank
+    with a never-used id, BLUEFOG_JOIN_COUNT set, and the grown world
+    size — the in-process signal that it must bootstrap by neighbor pull,
+    not checkpoint."""
+    import sys
+    scale = tmp_path / "scale"
+    marker = tmp_path / "marker"
+    prog = (
+        "import os, sys, time\n"
+        "rank = os.environ['BLUEFOG_PROCESS_ID']\n"
+        "jc = os.environ.get('BLUEFOG_JOIN_COUNT')\n"
+        "if jc:\n"
+        "    open(%r, 'w').write('JOIN_COUNT=%%s PROCESS_ID=%%s "
+        "NUM_PROCESSES=%%s' %% (jc, rank, "
+        "os.environ['BLUEFOG_NUM_PROCESSES']))\n"
+        "    sys.exit(0)\n"
+        "if rank == '0':\n"
+        "    open(%r, 'w').write('3')\n"
+        "    for _ in range(600):\n"
+        "        if os.path.exists(%r): sys.exit(0)\n"
+        "        time.sleep(0.05)\n"
+        "    sys.exit(1)\n"
+        "sys.exit(0)\n" % (str(marker), str(scale), str(marker)))
+    code = launcher.main(
+        ["-np", "2", "--elastic", "--scale-file", str(scale),
+         "--", sys.executable, "-c", prog])
+    assert code == 0
+    err = capsys.readouterr().err
+    assert "elastic join: starting rank 2 (target 3)" in err
+    got = marker.read_text()
+    assert "JOIN_COUNT=1" in got
+    assert "PROCESS_ID=2" in got
+    assert "NUM_PROCESSES=3" in got
+
+
+def test_elastic_retire_sigterms_highest_ranks(tmp_path, capsys):
+    """--elastic: a scale target below the slot count SIGTERMs the
+    highest-numbered live ranks (graceful retire); any exit code counts
+    as a clean retirement, so the job still ends 0."""
+    import sys
+    import time
+    scale = tmp_path / "scale"
+    prog = (
+        "import os, sys, time\n"
+        "rank = os.environ['BLUEFOG_PROCESS_ID']\n"
+        "if rank == '0':\n"
+        "    time.sleep(0.3)\n"
+        "    open(%r, 'w').write('1')\n"
+        "    sys.exit(0)\n"
+        "if rank == '1':\n"
+        "    sys.exit(0)\n"
+        "time.sleep(600)\n" % str(scale))
+    t0 = time.perf_counter()
+    code = launcher.main(
+        ["-np", "3", "--elastic", "--scale-file", str(scale),
+         "--", sys.executable, "-c", prog])
+    assert code == 0
+    assert time.perf_counter() - t0 < 60
+    err = capsys.readouterr().err
+    assert "elastic retire: stopping rank 2 (target 1)" in err
+    assert "rank 2 retired (exit code" in err

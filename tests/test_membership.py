@@ -1,0 +1,470 @@
+"""Elastic membership: admit/retire schedule surgery, the neighbor-pull
+bootstrap, seeded chaos `join` churn, the membership-invariant property
+sweep, and the kill-2-then-join-3 acceptance run on ExponentialTwoGraph(8).
+"""
+import importlib.util
+import json
+import pathlib
+import random
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import optax
+import pytest
+
+import bluefog_tpu as bf
+from bluefog_tpu import diagnostics as bfdiag
+from bluefog_tpu import optimizers as bfopt
+from bluefog_tpu import resilience as rz
+from bluefog_tpu import schedule as sch
+from bluefog_tpu import topology as tu
+from bluefog_tpu.utils import chaos
+from bluefog_tpu.utils import flight
+from bluefog_tpu.utils import metrics as bfm
+
+N, D = 8, 16
+
+FIXTURES = pathlib.Path(__file__).parent / "fixtures"
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    bfm.reset_metrics()
+    chaos.uninstall()
+    rz.reset()
+    bfdiag.reset_peer_health()
+    flight.reset()
+    yield
+    chaos.uninstall()
+    rz.reset()
+    bfdiag.reset_peer_health()
+    flight.reset()
+    bfm.stop_metrics()
+    bfm.reset_metrics()
+
+
+@pytest.fixture
+def ctx(cpu_devices):
+    bf.init(devices=cpu_devices)
+    bf.set_topology(tu.ExponentialTwoGraph(N), is_weighted=True)
+    yield
+    bf.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# membership_schedule: the pure surgery (no mesh needed)
+# ---------------------------------------------------------------------------
+
+def test_membership_schedule_inactive_matches_heal():
+    sched = sch.compile_topology(tu.ExponentialTwoGraph(N), weighted=True)
+    a = rz.schedule_weight_matrix(
+        rz.membership_schedule(sched, inactive=[2, 5]))
+    b = rz.schedule_weight_matrix(rz.heal_schedule(sched, [2, 5]))
+    np.testing.assert_allclose(a, b, atol=1e-12)
+    # empty membership state is the identity transform
+    np.testing.assert_allclose(
+        rz.schedule_weight_matrix(rz.membership_schedule(sched)),
+        rz.schedule_weight_matrix(sched), atol=1e-12)
+
+
+def test_membership_schedule_draining_keeps_out_edges():
+    sched = sch.compile_topology(tu.ExponentialTwoGraph(N), weighted=True)
+    drained = rz.membership_schedule(sched, draining=[3])
+    assert sch.columns_stochastic(drained)
+    # rank 3 stopped receiving…
+    assert drained.in_neighbors[3] == ()
+    W = rz.schedule_weight_matrix(drained)
+    assert W[3, 3] == 1.0
+    # …but still pushes its state out for one more round (Exp2: 3 feeds
+    # dsts 4, 5, 7), at the pristine weights
+    W0 = rz.schedule_weight_matrix(sched)
+    for dst in (4, 5, 7):
+        assert 3 in drained.in_neighbors[dst]
+        assert W[3, dst] == pytest.approx(W0[3, dst])
+
+
+def test_membership_schedule_entry_scale_ramps_and_stays_stochastic():
+    sched = sch.compile_topology(tu.ExponentialTwoGraph(N), weighted=True)
+    W0 = rz.schedule_weight_matrix(sched)
+    for alpha in (0.25, 0.5, 1.0):
+        s = rz.membership_schedule(sched, entry_scale={3: alpha})
+        assert sch.columns_stochastic(s)
+        W = rz.schedule_weight_matrix(s)
+        for dst in (4, 5, 7):
+            assert W[3, dst] == pytest.approx(W0[3, dst] * alpha)
+            # the held-back mass sits on the receiver's own diagonal
+            assert W[dst, dst] == pytest.approx(
+                W0[dst, dst] + W0[3, dst] * (1 - alpha))
+    with pytest.raises(ValueError, match="entry scale"):
+        rz.membership_schedule(sched, entry_scale={3: 0.0})
+
+
+# ---------------------------------------------------------------------------
+# The registry against a live context
+# ---------------------------------------------------------------------------
+
+def test_admit_rank_restores_pristine_edges_and_health(ctx):
+    W0 = rz.schedule_weight_matrix(bf.static_schedule())
+    rz.mark_rank_dead(3)
+    assert bfdiag.unhealthy_ranks() == (3,)
+    assert 3 not in bf.in_neighbor_ranks(4)
+    live = rz.admit_rank(3)
+    assert live == tuple(range(N))
+    assert rz.dead_ranks() == ()
+    # exact inverse: every restored in-edge carries its pristine weight
+    np.testing.assert_allclose(
+        rz.schedule_weight_matrix(bf.static_schedule()), W0, atol=1e-6)
+    assert 3 in bf.in_neighbor_ranks(4)
+    # re-admission clears the peer-failure record
+    assert bfdiag.unhealthy_ranks() == ()
+    assert bfm.gauge("bluefog_dead_ranks").value() == 0.0
+    assert bfm.gauge("bluefog_live_ranks").value() == float(N)
+    c = bfm.counter("bluefog_membership_changes_total")
+    assert c.value(change="dead") == 1 and c.value(change="join") == 1
+    assert bfm.metrics_summary()["resilience"]["membership_changes"] == 2.0
+    # idempotent for a live rank: no extra surgery, no extra count
+    assert rz.admit_rank(3) == tuple(range(N))
+    assert c.value(change="join") == 1
+
+
+def test_retire_announces_drains_then_leaves(ctx):
+    W0 = rz.schedule_weight_matrix(bf.static_schedule())
+    out = rz.retire_rank(5)                    # announce
+    assert out == (5,)
+    s = bf.static_schedule()
+    assert sch.columns_stochastic(s)
+    assert s.in_neighbors[5] == ()             # stopped receiving
+    assert 5 in s.in_neighbors[6]              # still sending (drain round)
+    assert rz.retired_ranks() == (5,)
+    assert 5 in rz.live_ranks()                # draining still participates
+    st = rz.advance_membership()               # the drain round has run
+    assert st["changed"] and st["retired"] == (5,)
+    s = bf.static_schedule()
+    assert sch.columns_stochastic(s)
+    assert s.in_neighbors[5] == () and 5 not in s.in_neighbors[6]
+    assert 5 not in rz.live_ranks()
+    # no peer-failure record: leaving is intentional, not a fault
+    assert bfdiag.unhealthy_ranks() == ()
+    assert bfm.gauge("bluefog_live_ranks").value() == float(N - 1)
+    # immediate retirement skips the drain round entirely
+    rz.retire_rank(2, drain=False)
+    s = bf.static_schedule()
+    assert 2 not in s.in_neighbors[3] and s.in_neighbors[2] == ()
+    np.testing.assert_allclose(
+        rz.schedule_weight_matrix(bf.static_schedule()).sum(axis=0),
+        np.ones(N), atol=1e-6)
+    # admission brings a retiree back to the pristine matrix
+    rz.admit_rank(2, 5)
+    np.testing.assert_allclose(
+        rz.schedule_weight_matrix(bf.static_schedule()), W0, atol=1e-6)
+
+
+def test_retire_refuses_to_empty_the_mesh(ctx):
+    rz.mark_rank_dead(1, 2, 3)
+    rz.retire_rank(4, 5, 6, drain=False)
+    with pytest.raises(ValueError, match="last live rank"):
+        rz.retire_rank(0, 7)
+    with pytest.raises(ValueError, match="dead or retired"):
+        rz.mark_rank_dead(0, 7)
+
+
+def test_admit_warmup_ramps_to_nominal(ctx):
+    W0 = rz.schedule_weight_matrix(bf.static_schedule())
+    rz.mark_rank_dead(3)
+    rz.admit_rank(3, warmup_steps=2)
+    W = rz.schedule_weight_matrix(bf.static_schedule())
+    assert W[3, 4] == pytest.approx(W0[3, 4] / 3)       # alpha = 1/3
+    assert sch.columns_stochastic(bf.static_schedule())
+    st = rz.advance_membership()
+    assert st["warming"] == {3: pytest.approx(2 / 3)}
+    W = rz.schedule_weight_matrix(bf.static_schedule())
+    assert W[3, 4] == pytest.approx(W0[3, 4] * 2 / 3)
+    st = rz.advance_membership()                         # ramp complete
+    assert st["changed"] and st["warming"] == {}
+    np.testing.assert_allclose(
+        rz.schedule_weight_matrix(bf.static_schedule()), W0, atol=1e-6)
+    assert not rz.advance_membership()["changed"]        # steady: free
+
+
+def test_membership_applies_to_dynamic_schedules(ctx):
+    topo = tu.ExponentialTwoGraph(N)
+    bf.set_dynamic_topology(
+        lambda r: tu.GetDynamicOnePeerSendRecvRanks(topo, r))
+    pristine = [rz.schedule_weight_matrix(s) for s in bf.dynamic_schedules()]
+    rz.mark_rank_dead(2)
+    rz.retire_rank(6, drain=False)
+    for s in bf.dynamic_schedules():
+        assert sch.columns_stochastic(s)
+        for dst in range(N):
+            if dst not in (2, 6):
+                assert 2 not in s.in_neighbors[dst]
+                assert 6 not in s.in_neighbors[dst]
+    rz.admit_rank(2, 6)
+    for W0, s in zip(pristine, bf.dynamic_schedules()):
+        np.testing.assert_allclose(rz.schedule_weight_matrix(s), W0,
+                                   atol=1e-6)
+
+
+def test_user_retopology_becomes_new_pristine_baseline(ctx):
+    rz.mark_rank_dead(3)
+    # the user installs a fresh topology mid-flight: membership ops must
+    # regenerate from IT, not from the stale Exp2 baseline
+    bf.set_topology(tu.RingGraph(N), is_weighted=True)
+    ring_W = rz.schedule_weight_matrix(bf.static_schedule())
+    rz.mark_rank_dead(5)
+    rz.admit_rank(5, 3)
+    np.testing.assert_allclose(
+        rz.schedule_weight_matrix(bf.static_schedule()), ring_W, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# State transfer: the neighbor-pull bootstrap
+# ---------------------------------------------------------------------------
+
+def test_bootstrap_params_pulls_average_of_live_neighbors(ctx):
+    params = {"w": jnp.broadcast_to(
+        jnp.arange(float(N))[:, None], (N, D)).astype(jnp.float32),
+        "step": jnp.int32(7)}                # non-distributed leaf: untouched
+    rz.mark_rank_dead(3)
+    rz.retire_rank(7, drain=False)
+    out = rz.bootstrap_params(params, 3)
+    w = np.asarray(jax.device_get(out["w"]))
+    # donors of 3 = pristine in-nbrs {1, 2, 7} minus retired 7 -> {1, 2}
+    np.testing.assert_allclose(w[3], np.full(D, (1 + 2) / 2), atol=1e-6)
+    keep = [r for r in range(N) if r != 3]
+    np.testing.assert_allclose(
+        w[keep], np.asarray(jax.device_get(params["w"]))[keep], atol=1e-6)
+    assert int(out["step"]) == 7
+    ev = [e for e in flight.events()
+          if e["kind"] == "join" and e.get("name") == "bootstrap"]
+    assert ev and ev[-1]["donors"] == [1, 2] and ev[-1]["rank"] == 3
+
+
+def test_bootstrap_requires_min_live_neighbors(ctx):
+    params = {"w": jnp.zeros((N, D), jnp.float32)}
+    rz.mark_rank_dead(1, 2, 7)       # every pristine in-neighbor of 3 … gone
+    with pytest.raises(RuntimeError, match=">= 2"):
+        rz.bootstrap_params(params, 3)
+    with pytest.raises(ValueError, match="not live"):
+        rz.bootstrap_params(params, 3, donors=[1, 4])
+
+
+def test_chaos_join_trigger_runs_full_join_protocol(ctx):
+    """The seeded `join` fault re-admits a dead rank mid-run through the
+    real bootstrap+admit path, with the step output tree as the state."""
+    chaos.install("seed=11;kill:step=3,rank=3")
+    step, params, state, batch = _gossip_setup()
+    for _ in range(2):
+        params, state, loss = step(params, state, batch)
+    with pytest.raises(chaos.RankKilled):
+        step(params, state, batch)
+    chaos.uninstall()
+    rz.mark_rank_dead(3)
+    chaos.install("seed=11;join:step=2,rank=3,warmup=1")
+    step, params, state, batch = _gossip_setup(params)
+    params, state, loss = step(params, state, batch)
+    assert rz.dead_ranks() == (3,)
+    params, state, loss = step(params, state, batch)   # join fires here
+    assert rz.dead_ranks() == ()
+    assert rz.live_ranks() == tuple(range(N))
+    assert bfm.counter("bluefog_faults_injected_total").value(
+        kind="join") == 1
+    assert bfm.counter("bluefog_membership_changes_total").value(
+        change="join") == 1
+    # rank 3's row was re-seeded from >= 2 live donors
+    ev = [e for e in flight.events()
+          if e["kind"] == "join" and e.get("name") == "bootstrap"]
+    assert ev and len(ev[-1]["donors"]) >= 2
+    # the already-live rank is a no-op on replay of the same fault step
+    assert chaos.apply_membership(params, 2) is params
+
+
+# ---------------------------------------------------------------------------
+# Property: any dead/admit/retire interleaving keeps every schedule
+# column-stochastic and the graph view consistent with the tables
+# ---------------------------------------------------------------------------
+
+def _check_membership_invariants():
+    scheds = [bf.static_schedule()] + list(bf.dynamic_schedules() or ())
+    for s in scheds:
+        assert sch.columns_stochastic(s), "column stochasticity violated"
+    s = bf.static_schedule()
+    for dst in range(N):
+        assert tuple(bf.in_neighbor_ranks(dst)) == tuple(
+            s.in_neighbors[dst]), (
+            f"graph view and compiled tables disagree at dst {dst}")
+
+
+def test_membership_interleaving_property(ctx):
+    topo = tu.ExponentialTwoGraph(N)
+    bf.set_dynamic_topology(
+        lambda r: tu.GetDynamicOnePeerSendRecvRanks(topo, r))
+    pristine = rz.schedule_weight_matrix(bf.static_schedule())
+    rng = random.Random(1234)
+    for _ in range(60):
+        op = rng.choice(["dead", "admit", "retire", "retire_now", "advance"])
+        r = rng.randrange(N)
+        gone = set(rz.dead_ranks()) | set(rz.retired_ranks())
+        try:
+            if op == "dead":
+                rz.mark_rank_dead(r)
+            elif op == "admit":
+                rz.admit_rank(r, warmup_steps=rng.choice([0, 1, 3]))
+            elif op == "retire":
+                rz.retire_rank(r)
+            elif op == "retire_now":
+                rz.retire_rank(r, drain=False)
+            else:
+                rz.advance_membership()
+        except ValueError:
+            # refused to empty the mesh — the registry must be unchanged
+            assert set(rz.dead_ranks()) | set(rz.retired_ranks()) == gone
+        _check_membership_invariants()
+    # admitting everyone restores the pristine matrix exactly
+    rz.advance_membership()
+    rz.admit_rank(*range(N))
+    while rz.advance_membership()["changed"]:
+        pass
+    np.testing.assert_allclose(
+        rz.schedule_weight_matrix(bf.static_schedule()), pristine, atol=1e-6)
+    _check_membership_invariants()
+
+
+# ---------------------------------------------------------------------------
+# Training-loop plumbing (mirrors test_resilience)
+# ---------------------------------------------------------------------------
+
+def grad_fn(params, batch):
+    loss = jnp.mean((params["w"] - batch) ** 2)
+    return loss, jax.grad(lambda p: jnp.mean((p["w"] - batch) ** 2))(params)
+
+
+def _gossip_setup(params=None):
+    """lr=0 strategy on the CURRENT (possibly membership-edited) static
+    schedule: params evolve only by mixing.  Rebuilding after a membership
+    change is the intended recompile the steady-state reset announces."""
+    strat = bfopt.adapt_with_combine(
+        optax.sgd(0.0), bfopt.neighbor_communicator(bf.static_schedule()))
+    if params is None:
+        params = {"w": jnp.broadcast_to(
+            jnp.arange(float(N))[:, None], (N, D)).astype(jnp.float32)}
+    state = bfopt.init_distributed(strat, params)
+    step = bfopt.make_train_step(grad_fn, strat)
+    return step, params, state, jnp.zeros((N, D), jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# Acceptance: kill 2 ranks mid-run, then join 3 new ranks (ROADMAP item 2)
+# ---------------------------------------------------------------------------
+
+def test_elastic_kill2_join3_acceptance(ctx):
+    # one slot is scaled away up-front so the later scale-up joins 3 ranks
+    rz.retire_rank(7, drain=False)
+
+    # -- phase 1: rank 3 dies mid-run ------------------------------------
+    chaos.install("seed=42;kill:step=4,rank=3")
+    step, params, state, batch = _gossip_setup()
+    for _ in range(3):
+        params, state, loss = step(params, state, batch)
+    with pytest.raises(chaos.RankKilled):
+        step(params, state, batch)
+    chaos.uninstall()
+    rz.mark_rank_dead(3)
+
+    # -- phase 2: rank 5 dies too; survivors keep contracting ------------
+    chaos.install("seed=42;kill:step=3,rank=5")
+    step, params, state, batch = _gossip_setup(params)
+    for _ in range(2):
+        params, state, loss = step(params, state, batch)
+    with pytest.raises(chaos.RankKilled):
+        step(params, state, batch)
+    chaos.uninstall()
+    rz.mark_rank_dead(5)
+
+    gone = (3, 5, 7)
+    step, params, state, batch = _gossip_setup(params)
+    dist = [bfdiag.diagnose_consensus(
+        params, dead_ranks=gone)["consensus_distance_max"]]
+    w1 = None
+    for i in range(6):
+        params, state, loss = step(params, state, batch)
+        jax.block_until_ready(loss)
+        if i == 0:
+            w1 = params["w"]
+        dist.append(bfdiag.diagnose_consensus(
+            params, dead_ranks=gone)["consensus_distance_max"])
+    assert all(b <= a + 1e-6 for a, b in zip(dist, dist[1:])), dist
+
+    # -- phase 3: join 3 ranks, each bootstrapped from >= 2 neighbors ----
+    params = rz.join_rank(3, params, warmup_steps=2, min_neighbors=2)
+    params = rz.join_rank(5, params, warmup_steps=2, min_neighbors=2)
+    params = rz.join_rank(7, params, warmup_steps=2, min_neighbors=2)
+    assert rz.live_ranks() == tuple(range(N))
+    assert rz.dead_ranks() == () and rz.retired_ranks() == ()
+
+    step, params, state, batch = _gossip_setup(params)
+    dist2 = [bfdiag.diagnose_consensus(params)["consensus_distance_max"]]
+    for _ in range(8):
+        params, state, loss = step(params, state, batch)
+        jax.block_until_ready(loss)
+        if rz.advance_membership()["changed"]:
+            # warmup ramp tick: an intended recompile, like the heal
+            step, params, state, batch = _gossip_setup(params)
+        dist2.append(
+            bfdiag.diagnose_consensus(params)["consensus_distance_max"])
+    # contraction is monotone through the join transition too, and the
+    # bootstrapped newcomers land near the survivors' consensus (far below
+    # the initial spread)
+    assert all(b <= a + 1e-6 for a, b in zip(dist2, dist2[1:])), dist2
+    assert dist2[0] <= 0.5 * dist[0], (dist2[0], dist[0])
+    assert dist2[-1] < 0.05 * dist[0], (dist2, dist)
+    w = np.asarray(jax.device_get(params["w"]))
+    assert np.isfinite(w).all()
+
+    # -- the trace: pull-based state transfer, no checkpoint restore ----
+    boots = [e for e in flight.events()
+             if e["kind"] == "join" and e.get("name") == "bootstrap"]
+    assert [e["rank"] for e in boots] == [3, 5, 7]
+    assert all(len(e["donors"]) >= 2 for e in boots), boots
+    assert not any(e["kind"] in ("restore", "checkpoint")
+                   for e in flight.events())
+
+    # -- health: donation intact, zero unexplained retraces, telemetry --
+    assert w1.is_deleted()
+    assert bfm.counter("bluefog_retrace_after_warmup_total").total() == 0
+    c = bfm.counter("bluefog_membership_changes_total")
+    assert c.value(change="dead") == 2
+    assert c.value(change="join") == 3
+    assert c.value(change="retire") == 1
+    assert bfm.gauge("bluefog_live_ranks").value() == float(N)
+    assert bfm.gauge("bluefog_dead_ranks").value() == 0.0
+    assert bfm.metrics_summary()["resilience"]["live_ranks"] == float(N)
+
+
+# ---------------------------------------------------------------------------
+# Postmortem on mixed-rank-count bundles (ranks born mid-run)
+# ---------------------------------------------------------------------------
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, pathlib.Path(__file__).parent.parent / "tools" / f"{name}.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_postmortem_tolerates_mixed_rank_counts():
+    pm = _load_tool("postmortem")
+    doc = pm.report_from_files([
+        str(FIXTURES / "flight_elastic_rank0.json"),
+        str(FIXTURES / "flight_elastic_rank8.json"),
+    ])
+    assert doc["ok"] and doc["schema"] == "bluefog-flight-1"
+    assert doc["ranks"] == [0, 8]
+    # the largest (newest) membership view wins; the size split is noted
+    assert doc["topology"]["size"] == 11
+    assert doc["topology"]["sizes_seen"] == [8, 11]
+    assert any("rank counts differ" in n for n in doc["notes"])
+    assert doc["verdict"]["first_failed_rank"] == 0
+    json.dumps(doc)                                   # fully serializable

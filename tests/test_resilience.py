@@ -287,6 +287,73 @@ def test_guard_without_snapshot_raises(ctx):
         guard(params, state, batch)
 
 
+def test_guard_consecutive_failures_walk_the_ring(ctx):
+    """Consecutive poisoned steps must roll back one snapshot DEEPER each
+    time (the restored snapshot is consumed), not replay the newest one
+    forever, and exhaustion reports the rollback depth."""
+    chaos.install("nan:step=3,rank=2;nan:step=4,rank=2;nan:step=5,rank=2")
+    step, params, state, batch = _gossip_setup()
+    guard = bf.guard_step(step, depth=2)
+
+    params, state, loss = guard(params, state, batch)     # good -> S1
+    w1 = np.asarray(jax.device_get(params["w"]))
+    params, state, loss = guard(params, state, batch)     # good -> S2
+    w2 = np.asarray(jax.device_get(params["w"]))
+    assert not np.array_equal(w1, w2)
+
+    params, state, loss = guard(params, state, batch)     # bad -> restore S2
+    assert guard.rollbacks == 1
+    np.testing.assert_array_equal(
+        np.asarray(jax.device_get(params["w"])), w2)
+
+    params, state, loss = guard(params, state, batch)     # bad -> restore S1
+    assert guard.rollbacks == 2
+    np.testing.assert_array_equal(
+        np.asarray(jax.device_get(params["w"])), w1)
+
+    with pytest.raises(FloatingPointError,
+                       match=r"2 rollback\(s\).*ranks \[2\]"
+                             r"|ranks \[2\].*2 rollback"):
+        guard(params, state, batch)                       # bad -> exhausted
+    assert guard.nonfinite_steps == 3
+
+
+def test_reset_clears_peer_failures_it_created(ctx):
+    """reset() must clear the peer-failure records mark_rank_dead wrote,
+    but leave records other subsystems created untouched."""
+    rz.mark_rank_dead(4)
+    bfdiag.record_peer_failure(6)          # e.g. the watchdog, not us
+    assert bfdiag.unhealthy_ranks() == (4, 6)
+    rz.reset()
+    assert bfdiag.unhealthy_ranks() == (6,)
+
+
+def test_heal_warns_once_when_send_scales_dropped(caplog):
+    """Healing a dst-weighted (push-sum style) schedule silently discarded
+    the send scales; now it says so — once — naming the affected ranks."""
+    n = 4
+    sched = sch.compile_from_weights(
+        n, [0.5] * n,
+        [{(i - 1) % n: 0.5} for i in range(n)],
+        [{(i + 1) % n: 0.5} for i in range(n)])
+    assert sched.uses_dst_weighting
+    import logging
+    with caplog.at_level(logging.WARNING):
+        rz.heal_schedule(sched, [1])
+        rz.heal_schedule(sched, [2])       # second heal: no second warning
+    warns = [r for r in caplog.records
+             if "send scales" in r.getMessage()]
+    assert len(warns) == 1
+    assert "[0, 1, 2, 3]" in warns[0].getMessage()
+    # plain (recv-weighted) schedules never warn
+    caplog.clear()
+    plain = sch.compile_topology(tu.ExponentialTwoGraph(n), weighted=True)
+    with caplog.at_level(logging.WARNING):
+        rz.heal_schedule(plain, [1])
+    assert not [r for r in caplog.records
+                if "send scales" in r.getMessage()]
+
+
 def test_guard_check_every_k_and_dead_mask(ctx):
     """Non-finite output on a rank already marked dead is NOT a fault —
     a healed-around rank's frozen shard may be anything."""

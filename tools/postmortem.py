@@ -128,24 +128,42 @@ def _consensus_trajectory(bundles):
 
 
 def _topology_block(bundles, notes):
+    candidates = []                     # (rank, ts, topology)
     for rank in sorted(bundles):
         topo = bundles[rank].get("topology")
         if not topo or "size" not in topo:
             continue
-        edges = []
-        in_nbrs = topo.get("in_neighbors")
-        if in_nbrs:
-            for dst, srcs in enumerate(in_nbrs):
-                edges.extend([int(src), dst] for src in srcs)
-        return {
-            "size": topo.get("size"),
-            "dead_ranks": topo.get("dead_ranks", []),
-            "healed": topo.get("healed", False),
-            "edges_at_failure": [list(e)
-                                 for e in sorted(map(tuple, edges))],
-        }
-    notes.append("no bundle carried a topology block")
-    return None
+        candidates.append((rank, bundles[rank].get("ts") or 0, topo))
+    if not candidates:
+        notes.append("no bundle carried a topology block")
+        return None
+    sizes = sorted({int(t["size"]) for _, _, t in candidates})
+    if len(sizes) > 1:
+        # elastic membership: ranks born mid-run dump a grown world view
+        notes.append(
+            "bundle rank counts differ (sizes %s) — ranks joined mid-run; "
+            "reporting the largest (newest) membership view"
+            % ", ".join(map(str, sizes)))
+    # largest world size wins, newest dump among those: the fleet's final
+    # membership view
+    _, _, topo = max(candidates, key=lambda c: (int(c[2]["size"]), c[1]))
+    edges = []
+    in_nbrs = topo.get("in_neighbors")
+    if in_nbrs:
+        for dst, srcs in enumerate(in_nbrs):
+            edges.extend([int(src), dst] for src in srcs)
+    out = {
+        "size": topo.get("size"),
+        "dead_ranks": topo.get("dead_ranks", []),
+        "healed": topo.get("healed", False),
+        "edges_at_failure": [list(e)
+                             for e in sorted(map(tuple, edges))],
+    }
+    if "retired_ranks" in topo:
+        out["retired_ranks"] = topo["retired_ranks"]
+    if len(sizes) > 1:
+        out["sizes_seen"] = sizes
+    return out
 
 
 def _step_time_block(bundles, per_rank):

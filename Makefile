@@ -7,7 +7,7 @@ PY ?= python
 .PHONY: test test-fast test_basic test_ops test_win_ops test_optimizer \
 	test_hier test_native test_examples verify native clean hw-watch \
 	obs-smoke chaos-smoke overlap-smoke postmortem-smoke pod-smoke \
-	autotune-smoke elastic-smoke
+	autotune-smoke elastic-smoke lm-smoke
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -145,6 +145,26 @@ elastic-smoke:
 		assert t['size'] == 11 and t['sizes_seen'] == [8, 11], t; \
 		assert any('rank counts differ' in n for n in d['notes']), d; \
 		print('elastic-smoke OK')"
+
+# composed-LLM smoke: the lm_bench/compose proof battery (artifact schema,
+# AOT leader-degree scaling, chaos blame, float64 trajectory oracle) plus
+# the grader itself end-to-end on the virtual mesh with a schema check —
+# the CPU rehearsal of the battery row hw_watch runs on hardware
+lm-smoke:
+	$(PY) -m pytest tests/test_lm_bench.py -q
+	$(PY) tools/lm_bench.py --virtual-cpu --smoke --wire bf16 \
+		--out /tmp/lm_bench_smoke.json
+	$(PY) -c "import json; \
+		d = json.load(open('/tmp/lm_bench_smoke.json')); \
+		assert d['schema'] == 'bluefog-lm-bench-1' and d['ok'], d; \
+		i = d['invariants']; \
+		assert i['donation_intact'] and \
+		i['retraces_after_warmup'] == 0, i; \
+		w = d['wire_bytes']; \
+		assert set(w['dcn']) == {'collective_permute'} and \
+		w['dcn_dtypes'] == ['bf16'] and w['ici_dtypes'] == ['f32'], w; \
+		assert d['tokens_per_sec'] > 0 and len(d['wire_sweep']) == 3, d; \
+		print('lm-smoke OK')"
 
 # resilience smoke: deterministic fault injection + healing/rollback on
 # the virtual CPU mesh (kill->heal->contract, NaN->rollback, restart

@@ -17,16 +17,35 @@ Semantics notes for the old-jax spellings:
   automatically via pbroadcast insertion.
 * ``jax.typeof`` returns the abstract value; it has no ``.vma`` attribute
   on old jax, which every caller already guards with ``getattr``/except.
+* ``psum`` inside a ``check_vma=True`` body transposes as the *identity*
+  on modern jax (``psum_invariant`` -> ``pvary``); 0.4.x re-psums the
+  cotangent, scaling every gradient through a psum'd loss by the axis
+  size.  The shard_map shim scopes a flag around the body and the patched
+  transpose rule keys on it, so ``check_vma=False`` regions keep the
+  legacy cotangent-sum semantics (tests that pin them say so explicitly).
 
 Each patch is applied only when the name is missing, so on a modern jax
 this module is a no-op and the native implementations are used.
 """
+import contextvars
 import functools
 
 import jax
 from jax import lax
 
 __all__ = ["install"]
+
+# True while tracing (and transposing, for grad-inside-shard_map) the body
+# of a check_vma=True shard_map on old jax — scoped by the shim below.
+_VMA_CHECKED_BODY = contextvars.ContextVar(
+    "bluefog_vma_checked_body", default=False)
+
+
+def in_vma_checked_body() -> bool:
+    """Whether the current trace is inside a ``check_vma=True`` shard_map
+    body (always False outside the old-jax shim; modern jax tracks this
+    natively via VMA and never consults it)."""
+    return _VMA_CHECKED_BODY.get()
 
 
 def _install_shard_map():
@@ -38,8 +57,21 @@ def _install_shard_map():
     def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True, **kw):
         kw.pop("axis_names", None)   # new-API only: subset-of-mesh manual axes
         check_rep = kw.pop("check_rep", check_vma)
-        return _legacy(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-                       check_rep=check_rep, **kw)
+
+        # Scope the VMA-semantics flag around the body: grad-inside
+        # transposition happens DURING the body trace, so the patched psum
+        # transpose (below) sees the right mode.  Set unconditionally so a
+        # nested check_vma=False region overrides an enclosing True one.
+        @functools.wraps(f)
+        def body(*args, **kwargs):
+            token = _VMA_CHECKED_BODY.set(bool(check_rep))
+            try:
+                return f(*args, **kwargs)
+            finally:
+                _VMA_CHECKED_BODY.reset(token)
+
+        return _legacy(body, mesh=mesh, in_specs=in_specs,
+                       out_specs=out_specs, check_rep=check_rep, **kw)
 
     jax.shard_map = shard_map
 
@@ -120,6 +152,47 @@ def _install_lowered_as_text_kwargs():
     stages.Lowered.as_text = as_text
 
 
+def _install_psum_vma_transpose():
+    """Old jax transposes ``psum`` to ``psum``: inside a shard_map body the
+    cotangent of a psum'd loss is the (replicated) seed re-summed over the
+    axis — every gradient comes back scaled by the axis size.  Modern jax
+    (vma) lowers the checked psum to ``psum_invariant`` whose transpose is
+    ``pvary``, the identity on the per-device value.  Re-register the
+    transpose rule to follow the modern semantics while the
+    ``check_vma=True`` body flag is set (see :func:`in_vma_checked_body`);
+    everywhere else — ``check_vma=False`` bodies, pmap, no shard_map at
+    all — the legacy rule runs unchanged."""
+    from jax._src.lax import parallel as lax_parallel
+
+    if hasattr(lax_parallel, "psum_invariant_p"):
+        return                      # modern jax: vma handles this natively
+    legacy_rule = getattr(lax_parallel, "_psum_transpose_rule", None)
+    if legacy_rule is None or not hasattr(lax_parallel, "psum_p"):
+        return
+    from jax._src import ad_util
+    from jax._src.lax import lax as lax_core
+    from jax.interpreters import ad
+
+    def vma_psum_transpose(cts, *args, axes, axis_index_groups):
+        if not _VMA_CHECKED_BODY.get():
+            return legacy_rule(cts, *args, axes=axes,
+                               axis_index_groups=axis_index_groups)
+        pos_axes = [a for a in axes if isinstance(a, int)]
+        if pos_axes:
+            def broadcast_positional(ct, arg):
+                assert ad.is_undefined_primal(arg)
+                if type(ct) is ad_util.Zero:
+                    return ad_util.Zero(arg.aval)
+                return lax_core._reduce_sum_transpose_rule(
+                    ct, arg, axes=pos_axes)[0]
+            cts = list(map(broadcast_positional, cts, args))
+        # named axes transpose to pvary: identity on the value (the seed is
+        # already replicated across the axis, each shard keeps its copy)
+        return list(cts)
+
+    ad.deflinear2(lax_parallel.psum_p, vma_psum_transpose)
+
+
 def _install_distributed_is_initialized():
     if hasattr(jax.distributed, "is_initialized"):
         return
@@ -142,6 +215,7 @@ def install():
     _install_axis_size()
     _install_shape_dtype_struct_vma()
     _install_lowered_as_text_kwargs()
+    _install_psum_vma_transpose()
     _install_distributed_is_initialized()
 
 

@@ -202,6 +202,12 @@ def ulysses_attention(
         # collective transposition, mirroring the ring path's design
         return _pallas_ulysses(q, k, v, axis, causal, float(scale),
                                pallas_block_q, pallas_interpret)
+    if n == 1:
+        # degenerate axis (e.g. an sp=1 carving in parallel/compose): the
+        # block already holds the full sequence and all heads — skip the
+        # two size-1 all_to_alls so composed programs pay zero collectives
+        # for the unused axis
+        return _jnp_local_attention(q, k, v, causal, float(scale), axis=axis)
     qg, kg, vg = (_scatter_heads(t, axis) for t in (q, k, v))
     out = _jnp_local_attention(qg, kg, vg, causal, float(scale), axis=axis)
     return _gather_heads(out, axis)

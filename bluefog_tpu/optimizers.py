@@ -42,6 +42,7 @@ import numpy as np
 
 import optax
 from jax import lax
+from jax.sharding import Mesh
 from jax.sharding import PartitionSpec as P
 
 from . import fusion, ops
@@ -1559,11 +1560,12 @@ def _check_metrics_every_k(metrics_every_k, strategy):
         return
     if metrics_every_k < 1:
         raise ValueError("metrics_every_k must be >= 1")
-    if strategy.axes != ("rank",):
+    if strategy.axes[:1] != ("rank",):
         raise ValueError(
-            "metrics_every_k requires a rank-axis strategy; the consensus "
-            "probe runs over the 1-D mesh — call diagnose_consensus "
-            "manually for hierarchical strategies")
+            "metrics_every_k requires a strategy that gossips over the "
+            "rank axis (axes[0] == 'rank'); the consensus probe runs over "
+            "the 1-D mesh — call diagnose_consensus manually for "
+            "hierarchical strategies")
 
 
 def _check_overlap(overlap, strategy):
@@ -1587,6 +1589,9 @@ def make_train_step(
     overlap: bool = False,
     metrics_every_k: Optional[int] = None,
     metrics_warmup: int = 2,
+    mesh: Optional[Mesh] = None,
+    in_spec: Optional[P] = None,
+    check_vma: bool = True,
 ):
     """Build the jitted SPMD training step over the context mesh.
 
@@ -1633,12 +1638,24 @@ def make_train_step(
     the gossip under compute.  The flag is surfaced in the metrics registry
     (``bluefog_step_overlap``) and validated here rather than inferred, so
     a bulk-synchronous strategy silently losing the overlap is impossible.
+
+    ``mesh=``/``in_spec=`` override the context mesh for composed
+    parallelism (:mod:`bluefog_tpu.parallel.compose` builds a 4-D
+    gossip-DP x PP x TP x SP mesh and passes it here): every leaf still
+    carries ONE leading device axis, collapsed over all mesh axes.
+    ``check_vma=False`` opts the body out of replication checking — the
+    composed LM gradient recipe relies on the legacy cotangent-sum psum
+    transpose (see examples/llm_3d.py and tests/test_compose.py).
     """
     _check_metrics_every_k(metrics_every_k, strategy)
     _check_overlap(overlap, strategy)
-    ctx = _mesh.get_context()
-    mesh = ctx.mesh if strategy.axes == ("rank",) else ctx.mesh_2d
-    spec = P("rank") if strategy.axes == ("rank",) else P(("machine", "local"))
+    if mesh is None:
+        ctx = _mesh.get_context()
+        mesh = ctx.mesh if strategy.axes == ("rank",) else ctx.mesh_2d
+        spec = (P("rank") if strategy.axes == ("rank",)
+                else P(("machine", "local")))
+    else:
+        spec = in_spec if in_spec is not None else P(tuple(mesh.axis_names))
 
     def grad3(p, ns, b):
         loss, grads = grad_fn(p, b)
@@ -1656,7 +1673,7 @@ def make_train_step(
     # parameter memory for large models)
     step = jax.jit(
         jax.shard_map(per_rank, mesh=mesh, in_specs=(spec, spec, spec),
-                      out_specs=(spec, spec, spec)),
+                      out_specs=(spec, spec, spec), check_vma=check_vma),
         donate_argnums=TRAIN_STEP_DONATE_ARGNUMS if donate else ())
     return _InstrumentedStep(
         step, steps_per_call=steps_per_call, donated=donate, overlap=overlap,

@@ -67,8 +67,11 @@ __all__ += [
 
 from . import pipeline
 from . import expert
+from . import compose
+from .compose import Mesh3D, compose_parallelism
 
-__all__ += ["tensor_parallel", "pipeline", "expert"]
+__all__ += ["tensor_parallel", "pipeline", "expert", "compose",
+            "Mesh3D", "compose_parallelism"]
 
 
 def __getattr__(name):

@@ -182,7 +182,8 @@ def init(
         env flag; ``"off"`` disables.  See
         ``docs/PERFORMANCE.md#pod-scale-hierarchical-gossip``.
     """
-    global _context
+    global _context, _active_compose
+    _active_compose = None    # a new context invalidates any prior carving
     from ..utils.config import setup_logging, env_int
     from ..utils.timeline import maybe_start_from_env
     from ..utils import metrics as _metrics
@@ -343,7 +344,8 @@ def shutdown() -> None:
     """Drop the context (reference: ``bf.shutdown``) — flushing any active
     timeline first, as the reference's shutdown drains its writer thread
     (``operations.cc:464-473``)."""
-    global _context
+    global _context, _active_compose
+    _active_compose = None
     from ..utils.timeline import stop_timeline
     from ..utils import metrics as _metrics
     from ..utils import chaos as _chaos
@@ -377,6 +379,22 @@ def machine_size() -> int:
 
 def devices() -> np.ndarray:
     return get_context().devices
+
+
+# The active composed-parallelism carving (a parallel.compose.Mesh3D), set
+# by compose_parallelism() so tools (lm_bench, flight postmortems) can read
+# the axis split without threading it through every call.  Cleared on
+# init/shutdown: a carving is only meaningful against the mesh it divided.
+_active_compose = None
+
+
+def set_compose(m) -> None:
+    global _active_compose
+    _active_compose = m
+
+
+def get_compose():
+    return _active_compose
 
 
 def mesh() -> Mesh:

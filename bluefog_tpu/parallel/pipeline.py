@@ -42,6 +42,22 @@ __all__ = ["pipeline_apply", "last_stage_value", "pipeline_1f1b_grad",
 Axis = str
 
 
+def _rep_varying(x) -> "set | None":
+    """Mesh axes ``x`` varies over, per OLD jax's shard_map replication
+    tracker (``check_rep=True`` wraps body values in tracers carrying a
+    ``rep`` set of axes the value is replicated over) — or ``None`` when no
+    tracker is attached: modern jax (vma does this natively), or a
+    ``check_vma=False`` body (legacy semantics, nothing to emulate)."""
+    rep = getattr(x, "rep", None)
+    if rep is None:
+        return None
+    try:
+        mesh_axes = set(x._trace.mesh.axis_names)
+    except AttributeError:
+        return None
+    return mesh_axes - set(rep)
+
+
 def _vary(z: jax.Array, axis: Axis, *likes) -> jax.Array:
     """pcast ``z`` varying over ``axis`` AND every mesh axis any leaf of
     ``likes`` already varies over: on a multi-axis mesh (e.g. stage x rank
@@ -243,6 +259,24 @@ def pipeline_1f1b_grad(
         vary(jnp.zeros((), jnp.float32)),                        # loss
     )
     (_, _, _, dparams, loss), _ = lax.scan(tick, carry0, jnp.arange(ticks))
+    # Axis-invariant params under axis-varying data (gossip-DP composition:
+    # params P("stage"), targets P("rank")): modern jax's vma-aware vjp
+    # psums the cotangent over every axis the data varies on but the param
+    # doesn't, inside ``jax.vjp`` itself.  Old jax has no such insertion —
+    # its replication tracker tells us which axes the hand-accumulated
+    # grads picked up beyond the params', and we close the gap with one
+    # explicit psum.  On modern jax ``_rep_varying`` returns None and this
+    # is a no-op (the sum already happened; summing again would double it).
+    extra: set = set()
+    for g_leaf, p_leaf in zip(jax.tree.leaves(dparams),
+                              jax.tree.leaves(stage_params)):
+        g_var = _rep_varying(g_leaf)
+        if g_var is None:
+            continue
+        extra |= g_var - (_rep_varying(p_leaf) or set())
+    if extra:
+        dparams = jax.tree.map(
+            lambda g: lax.psum(g, tuple(sorted(extra))), dparams)
     dparams = jax.tree.map(
         lambda g, p: g.astype(p.dtype), dparams, stage_params)
     return loss, dparams

@@ -110,3 +110,117 @@ def total_wire_bytes(hlo_txt: str) -> int:
     """Sum of :func:`wire_stats` bytes across all collective kinds."""
     _, bytes_ = wire_stats(hlo_txt)
     return int(sum(bytes_.values()))
+
+
+# ---------------------------------------------------------------------------
+# ICI-vs-DCN attribution from PRE-optimization StableHLO (jax `.lower()`
+# text).  Pre-opt is the honest layer for codec pins: the CPU backend
+# constant-folds bf16/fp8 casts in *compiled* HLO, but the traced program
+# states exactly what dtype each collective moves and between which devices.
+# ---------------------------------------------------------------------------
+
+_SHLO_DT_BYTES = {
+    "f64": 8, "i64": 8, "ui64": 8,
+    "f32": 4, "i32": 4, "ui32": 4,
+    "bf16": 2, "f16": 2, "i16": 2, "ui16": 2,
+    "f8E4M3FN": 1, "f8E5M2": 1, "f8E4M3B11FNUZ": 1,
+    "i8": 1, "ui8": 1, "i1": 1,
+}
+
+_SHLO_COLLECTIVES = ("collective_permute", "all_reduce", "all_to_all",
+                     "all_gather", "reduce_scatter")
+
+_SHLO_OP_RE = re.compile(
+    r'"stablehlo\.(' + "|".join(_SHLO_COLLECTIVES) + r')"')
+_SHLO_PAIRS_RE = re.compile(
+    r"source_target_pairs\s*=\s*dense<\[(.*?)\]>", re.S)
+_SHLO_GROUPS_RE = re.compile(
+    r"replica_groups\s*=\s*dense<\[(.*?)\]>", re.S)
+_SHLO_RESULT_RE = re.compile(r"->\s*\(?\s*(tensor<[^>]+>(?:,\s*tensor<[^>]+>)*)")
+_SHLO_TENSOR_RE = re.compile(r"tensor<((?:\d+x)*)([A-Za-z0-9]+)>")
+
+
+def _shlo_tensor_bytes(sig: str) -> int:
+    total = 0
+    for dims, dt in _SHLO_TENSOR_RE.findall(sig):
+        if dt not in _SHLO_DT_BYTES:
+            continue
+        n = 1
+        for d in dims.strip("x").split("x"):
+            if d:
+                n *= int(d)
+        total += n * _SHLO_DT_BYTES[dt]
+    return total
+
+
+def _shlo_groups(attr_payload: str):
+    """``[[0, 1], [2, 3]]`` inner text -> list of int lists."""
+    groups = []
+    for chunk in attr_payload.replace("[", "").split("]"):
+        nums = [int(x) for x in re.findall(r"-?\d+", chunk)]
+        if nums:
+            groups.append(nums)
+    return groups
+
+
+def stablehlo_wire_stats(stablehlo_txt: str, slice_size: int):
+    """Per-chip collective bytes split into cross-slice (DCN) vs
+    intra-slice (ICI) traffic, from pre-optimization StableHLO.
+
+    With the gossip-DP axis outermost (``parallel/compose`` orders devices
+    slice-major), devices ``[k*slice_size, (k+1)*slice_size)`` share slice
+    ``k``.  A collective is **cross-slice** iff any of its participant
+    pairs/groups spans two slice blocks (``device // slice_size`` differs)
+    — gossip permutes over the DP axis qualify; PP ppermutes, TP psums,
+    and SP all_to_alls never do.  Bytes are the op's result-tensor payload
+    counted once per static occurrence (per-chip, SPMD), the same
+    convention as the pod-scale AOT proofs.
+
+    Returns a dict: ``{"ici"|"dcn": {kind: {"count", "bytes"}},
+    "ici_bytes", "dcn_bytes", "ici_dtypes", "dcn_dtypes"}``.  Collectives
+    whose participant attribute cannot be parsed (e.g. hex-packed dense
+    literals at very large rank counts) are tallied under ``"unknown"``.
+    """
+    L = int(slice_size)
+    out = {"ici": {}, "dcn": {}, "unknown": {},
+           "ici_dtypes": set(), "dcn_dtypes": set()}
+    lines = stablehlo_txt.splitlines()
+    for i, line in enumerate(lines):
+        m = _SHLO_OP_RE.search(line)
+        if not m:
+            continue
+        kind = m.group(1)
+        pm = _SHLO_PAIRS_RE.search(line) or _SHLO_GROUPS_RE.search(line)
+        groups = _shlo_groups(pm.group(1)) if pm else None
+        if kind == "collective_permute" and groups:
+            # pairs parse as flat [src, dst] rows under either regex shape
+            flat = [x for g in groups for x in g]
+            groups = [flat[j:j + 2] for j in range(0, len(flat), 2)]
+        # result type: same line for single-line ops, else the region's
+        # closing `}) : (...) -> ...` line
+        sig_m = _SHLO_RESULT_RE.search(line)
+        j = i
+        while sig_m is None and j + 1 < len(lines):
+            j += 1
+            sig_m = _SHLO_RESULT_RE.search(lines[j])
+            if lines[j].lstrip().startswith('"stablehlo') and sig_m is None:
+                break
+        payload = _shlo_tensor_bytes(sig_m.group(1)) if sig_m else 0
+        dtypes = {dt for _, dt in
+                  _SHLO_TENSOR_RE.findall(sig_m.group(1))} if sig_m else set()
+        if groups is None:
+            side = "unknown"
+        elif any(len({d // L for d in g}) > 1 for g in groups):
+            side = "dcn"
+        else:
+            side = "ici"
+        slot = out[side].setdefault(kind, {"count": 0, "bytes": 0})
+        slot["count"] += 1
+        slot["bytes"] += payload
+        if side in ("ici", "dcn"):
+            out[side + "_dtypes"] |= dtypes
+    out["ici_bytes"] = sum(v["bytes"] for v in out["ici"].values())
+    out["dcn_bytes"] = sum(v["bytes"] for v in out["dcn"].values())
+    out["ici_dtypes"] = sorted(out["ici_dtypes"])
+    out["dcn_dtypes"] = sorted(out["dcn_dtypes"])
+    return out

@@ -1,31 +1,31 @@
-"""3-D parallel LM training: data x pipeline x tensor parallelism.
+"""Decentralized LLM at production shape: gossip-DP x PP x TP x Ulysses.
 
-The composition a pod-scale LM actually runs — on ONE mesh, in one
-compiled step:
+One call to :func:`bluefog_tpu.parallel.compose.compose_parallelism` carves
+the device mesh into four axes and validates the carving eagerly; the
+composed transformer then trains through the full step machinery — buffer
+donation, ``adapt_with_combine(delayed=True)`` pipelined gossip, and the
+retrace sentinel all survive composition:
 
-* ``tp``  — Megatron tensor parallelism *inside* every decoder block:
-  attention heads and the MLP hidden dim are column-split, output
-  projections row-split, one ``psum`` per sublayer rides the fastest
-  ICI axis.
-* ``stage`` — the block stack is pipelined (GPipe microbatches,
-  activations ``ppermute`` stage-to-stage; ``jax.grad`` through the
-  schedule IS the backward pipeline).
-* ``dp``  — data parallelism over the outermost axis: each dp slice
-  trains on its own shard and gradients are averaged across slices
-  (swap the ``pmean`` for a gossip communicator to make it
-  decentralized — the strategies in ``bluefog_tpu.optimizers`` are
-  pytree-generic).
+* ``dp``  — gossip data parallelism over the OUTERMOST axis: each replica
+  neighbor-averages its parameters with its DP peers over the configured
+  graph (default ``ExponentialTwoGraph``) instead of an allreduce.  With
+  slice-major device order these permutes are the only cross-slice (DCN)
+  traffic, and ``--wire bf16`` compresses exactly them.
+* ``pp``  — the block stack is pipelined (activations ``ppermute`` stage
+  to stage; ``jax.grad`` through the schedule IS the backward pipeline).
+* ``tp``  — Megatron tensor parallelism inside every decoder block
+  (column-split qkv/up, row-split out/down, one ``psum`` per sublayer).
+* ``sp``  — Ulysses sequence parallelism (two ``all_to_all``s re-shard
+  heads <-> sequence around local attention).
 
-Embedding/positional/head parameters are replicated across stage and tp
-(gradients psum'd over both); block parameters live only on their
-(stage, tp) owner.  A copy-task LM (predict the token ``lag`` positions
-back) trains to low loss, proving gradients flow through every stage
-boundary, every tp psum, and the dp average at once.
+A copy-task LM (predict the token ``lag`` positions back) trains to low
+loss, proving gradients flow through every stage boundary, tp psum, sp
+all_to_all, AND the gossip mixing at once.  The same model/recipe is what
+``tools/lm_bench.py`` grades and ``tests/test_compose.py`` pins against
+float64 oracles.
 
-Run: python examples/llm_3d.py --virtual-cpu --steps 60
-Reference contrast: the reference composes its decentralized DP with
-nothing else (optimizers.py is DP-only); this is the beyond-reference
-scale story (SURVEY.md §5 long-context/distributed).
+Run:  python examples/llm_3d.py --virtual-cpu --steps 60
+      python examples/llm_3d.py --virtual-cpu --sp 2 --tp 1 --wire fp8@64
 """
 import argparse
 import os
@@ -38,22 +38,24 @@ def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--virtual-cpu", action="store_true")
     parser.add_argument("--dp", type=int, default=2)
-    parser.add_argument("--stages", type=int, default=2)
+    parser.add_argument("--pp", "--stages", type=int, default=2, dest="pp")
     parser.add_argument("--tp", type=int, default=2)
+    parser.add_argument("--sp", type=int, default=1,
+                        help="Ulysses sequence-parallel ways")
+    parser.add_argument("--wire", default=None,
+                        help="gossip DCN codec (bf16 / fp8@64 / ...)")
+    parser.add_argument("--layers", type=int, default=4)
     parser.add_argument("--micro", type=int, default=4)
-    parser.add_argument("--seq-len", type=int, default=16)
-    parser.add_argument("--d-model", type=int, default=16)
-    parser.add_argument("--heads", type=int, default=2)
+    parser.add_argument("--seq-len", type=int, default=32)
+    parser.add_argument("--d-model", type=int, default=32)
+    parser.add_argument("--heads", type=int, default=4)
     parser.add_argument("--steps", type=int, default=100)
     parser.add_argument("--lag", type=int, default=2)
-    parser.add_argument("--lr", type=float, default=1e-2)
+    parser.add_argument("--lr", type=float, default=5e-3)
     parser.add_argument("--seed", type=int, default=0)
     args = parser.parse_args()
 
-    n_needed = args.dp * args.stages * args.tp
-    if args.d_model % args.heads or args.heads % args.tp:
-        parser.error("need d_model % heads == 0 and heads % tp == 0")
-
+    n_needed = args.dp * args.pp * args.tp * args.sp
     if args.virtual_cpu:
         flags = os.environ.get("XLA_FLAGS", "")
         if "host_platform_device_count" not in flags:
@@ -64,158 +66,43 @@ def main():
     import jax
     if args.virtual_cpu:
         jax.config.update("jax_platforms", "cpu")
-    import jax.numpy as jnp
     import numpy as np
     import optax
-    from jax import lax
-    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-    from bluefog_tpu.parallel.pipeline import pipeline_apply
 
-    DP, S, TP = args.dp, args.stages, args.tp
-    M, T, D, H = args.micro, args.seq_len, args.d_model, args.heads
-    B, vocab, F = 2, 32, 4 * args.d_model
-    Hl, hsz = H // TP, D // H                 # heads per tp rank
+    import bluefog_tpu as bf
+    from bluefog_tpu import optimizers as bfopt
+    from bluefog_tpu.parallel import compose
 
-    devices = jax.devices()
-    assert len(devices) >= n_needed, f"need {n_needed} devices"
-    mesh = Mesh(np.array(devices[:n_needed]).reshape(DP, S, TP),
-                ("dp", "stage", "tp"))
+    bf.init(platform="cpu" if args.virtual_cpu else None)
 
-    rng = np.random.default_rng(args.seed)
+    # one call carves + validates the whole 4-axis layout
+    m = compose.compose_parallelism(
+        args.dp, args.pp, args.tp, args.sp,
+        devices=bf.devices().ravel()[:n_needed], wire=args.wire)
+    cfg = compose.LMConfig(
+        d_model=args.d_model, heads=args.heads, layers=args.layers,
+        seq_len=args.seq_len, micro=args.micro, lag=args.lag)
+    cfg.validate(m)
+    print(f"[llm_3d] carving {m.describe()}")
 
-    def w(*shape, scale=0.1):
-        return jnp.asarray(rng.normal(size=shape) * scale, jnp.float32)
+    grad_fn = compose.make_lm_grad_fn(cfg, m)
+    step, strategy = compose.make_train_step(
+        m, grad_fn, optax.adam(args.lr))
+    params = compose.init_lm_params(cfg, m, seed=args.seed)
+    state = bfopt.init_distributed(strategy, params)
+    toks = compose.make_lm_batch(cfg, m, seed=args.seed)
+    params = compose.device_put(m, params)
 
-    # block params [S, TP, ...]: column-split qkv/w1, row-split wo/w2
-    blocks = {
-        "wqkv": w(S, TP, D, 3 * D // TP),
-        "wo":   w(S, TP, D // TP, D),
-        "w1":   w(S, TP, D, F // TP),
-        "w2":   w(S, TP, F // TP, D),
-    }
-    shared = {"embed": w(vocab, D), "pos": w(T, D), "head": w(D, vocab)}
-    params = {
-        # replicate blocks over dp; shared over everything
-        "blocks": jax.tree.map(
-            lambda t: jnp.broadcast_to(t, (DP,) + t.shape), blocks),
-        "shared": shared,
-    }
-
-    def ln(z):
-        mu = z.mean(-1, keepdims=True)
-        return (z - mu) / jnp.sqrt(z.var(-1, keepdims=True) + 1e-6)
-
-    def block_fn(p, x):
-        # attention: this tp rank computes ITS Hl heads, row-parallel wo
-        h = ln(x)
-        qkv = h @ p["wqkv"]                       # [B, T, 3*D/TP]
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-        q = q.reshape(B, T, Hl, hsz)
-        k = k.reshape(B, T, Hl, hsz)
-        v = v.reshape(B, T, Hl, hsz)
-        sc = jnp.einsum("bihd,bjhd->bhij", q, k) / jnp.sqrt(float(hsz))
-        mask = jnp.tril(jnp.ones((T, T), bool))
-        sc = jnp.where(mask[None, None], sc, -jnp.inf)
-        att = jnp.einsum("bhij,bjhd->bihd", jax.nn.softmax(sc, -1), v)
-        x = x + lax.psum(att.reshape(B, T, D // TP) @ p["wo"], "tp")
-        # MLP: column-split w1, row-split w2
-        h = ln(x)
-        return x + lax.psum(jax.nn.gelu(h @ p["w1"]) @ p["w2"], "tp")
-
-    def train_step(p, opt_state, tokens):
-        # block views: blocks [1,1,1,...] -> local; shared replicated
-        local = {
-            "blocks": jax.tree.map(lambda t: t[0, 0, 0], p["blocks"]),
-            "shared": p["shared"],
-        }
-        toks = tokens[0]                          # [M, B, T] this dp shard
-        sid = lax.axis_index("stage")
-
-        def loss_fn(q):
-            x = q["shared"]["embed"][toks] + q["shared"]["pos"]  # [M,B,T,D]
-            out = pipeline_apply(block_fn, q["blocks"], x, axis="stage")
-            # exact-gradient recipe (pinned by tests/test_compose.py::
-            # test_dp_pp_tp_three_axis_composition): NO loss-side
-            # collective inside AD — mask the loss to the last stage
-            # (other stages' `out` is zeros) and seed the tp-replicated
-            # output's cotangent once (1/TP); the structural row-parallel
-            # psums transpose as cotangent sums that restore full scale.
-            logits = ln(out) @ q["shared"]["head"]
-            targets = jnp.roll(toks, args.lag, axis=-1)
-            loss = optax.softmax_cross_entropy_with_integer_labels(
-                logits[:, :, args.lag:], targets[:, :, args.lag:]).mean()
-            return jnp.where(sid == S - 1, loss, 0.0) / TP
-
-        loss, g = jax.value_and_grad(loss_fn)(local)
-        # outside AD: replicate the true loss; dp-average everything;
-        # shared grads are per-role partial sums -> one psum(stage, tp)
-        loss = lax.psum(loss, ("stage", "tp"))
-        g = jax.tree.map(lambda t: lax.pmean(t, "dp"), g)
-        g["shared"] = jax.tree.map(
-            lambda t: lax.psum(t, ("stage", "tp")), g["shared"])
-        updates, new_opt = opt.update(g, _localize(opt_state), local)
-        new = optax.apply_updates(local, updates)
-        return ({"blocks": jax.tree.map(lambda t: t[None, None, None],
-                                        new["blocks"]),
-                 "shared": new["shared"]},
-                _expand(new_opt), loss[None, None, None])
-
-    opt = optax.adam(args.lr)
-
-    # optimizer state: block moments are genuinely distinct per (stage, tp)
-    # owner — their sharding must say so (a replicated P() spec would let a
-    # checkpoint save/reshard silently overwrite every rank's moments with
-    # device 0's).  Shared-param moments are identical everywhere.
-    from jax.tree_util import tree_map_with_path
-
-    def _under_blocks(path):
-        return any(getattr(k, "key", None) == "blocks" for k in path)
-
-    def _localize(s):
-        return tree_map_with_path(
-            lambda pth, t: t[0, 0, 0] if _under_blocks(pth) else t, s)
-
-    def _expand(s):
-        return tree_map_with_path(
-            lambda pth, t: t[None, None, None] if _under_blocks(pth) else t,
-            s)
-
-    opt_state_local = opt.init({
-        "blocks": jax.tree.map(lambda t: t[0, 0], blocks),
-        "shared": shared,
-    })
-    opt_state = tree_map_with_path(
-        lambda pth, t: jnp.broadcast_to(t, (DP, S, TP) + t.shape)
-        if _under_blocks(pth) else t, opt_state_local)
-    specs_opt = tree_map_with_path(
-        lambda pth, _: P("dp", "stage", "tp") if _under_blocks(pth)
-        else P(), opt_state)
-
-    specs_p = {
-        "blocks": jax.tree.map(lambda _: P("dp", "stage", "tp"),
-                               params["blocks"]),
-        "shared": jax.tree.map(lambda _: P(), params["shared"]),
-    }
-    step = jax.jit(jax.shard_map(
-        train_step, mesh=mesh,
-        in_specs=(specs_p, specs_opt, P("dp", None, None, None)),
-        out_specs=(specs_p, specs_opt, P("dp", "stage", "tp")),
-        check_vma=False))
-
-    data = rng.integers(0, vocab, size=(DP, M, B, T))
-    tokens = jax.device_put(
-        jnp.asarray(data, jnp.int32), NamedSharding(mesh, P("dp")))
-
-    first = None
+    first = l = None
     for i in range(args.steps):
-        params, opt_state, loss = step(params, opt_state, tokens)
+        params, state, loss = step(params, state, toks)
         l = float(np.asarray(loss).mean())
         first = l if first is None else first
         if i % 20 == 0 or i == args.steps - 1:
             print(f"step {i}: loss {l:.4f}", flush=True)
-    print(f"[llm_3d] mesh dp={DP} x stage={S} x tp={TP}: "
-          f"loss {first:.3f} -> {l:.3f}")
-    assert l < first * 0.7, "3-D parallel LM failed to train"
+    print(f"[llm_3d] mesh dp={m.dp} x pp={m.pp} x tp={m.tp} x sp={m.sp}"
+          f" (wire={m.wire}): loss {first:.3f} -> {l:.3f}")
+    assert l < first * 0.7, "composed LM failed to train"
 
 
 if __name__ == "__main__":

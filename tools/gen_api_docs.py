@@ -30,6 +30,8 @@ MODULES = [
     ("bluefog_tpu.parallel.context", "Mesh context (init/topology state)"),
     ("bluefog_tpu.parallel.windows", "Window registry (named windows)"),
     ("bluefog_tpu.parallel.pipeline", "Pipeline parallelism"),
+    ("bluefog_tpu.parallel.compose",
+     "Composed parallelism (gossip-DP x PP x TP x Ulysses)"),
     ("bluefog_tpu.parallel.tensor_parallel", "Tensor parallelism"),
     ("bluefog_tpu.parallel.expert", "Expert (MoE) parallelism"),
     ("bluefog_tpu.checkpoint", "Checkpointing (orbax, elastic, async)"),

@@ -159,17 +159,15 @@ def _battery_steps(tag: str, stage: int = 0) -> list:
               "--trace", os.path.join(m, f"trace_{tag}")], 5400, None, None),
         ]
         if os.path.exists(lm):
-            # 8192 tokens is Pallas-only: on ONE chip the ring is a single
-            # block, so the XLA path materializes the full [B,T,H,T]
-            # score tensor — ~34 GB at batch 8 against 16 GB of HBM.
-            # Flash (O(block_q) VMEM) is the long-context story anyway;
-            # the XLA-attention row is banked at 4096 by stage 0.
-            # --remat: at 8192x8 the per-layer MLP/attention residuals
-            # (~1 GB/layer bf16) would not survive to the backward in
-            # 16 GB HBM; nothing_saveable keeps only layer inputs
+            # 8192 tokens is flash-only: the XLA local-attention path
+            # materializes the full score tensor, which at long context
+            # does not fit 16 GB of HBM.  Flash (O(block_q) VMEM) is the
+            # long-context story anyway; the XLA-attention row is banked
+            # at 2048 by stage 0.  --remat: long-sequence residuals would
+            # not survive to the backward otherwise.
             steps.append(("lm_bench_long_pallas",
-                          [py, lm, "--seq", "8192", "--batch", "8",
-                           "--remat", "--out",
+                          [py, lm, "--pallas", "--seq", "8192",
+                           "--batch", "2", "--remat", "--out",
                            os.path.join(m, f"lm_bench_pallas_{tag}.json")],
                           3600, None, None))
         if os.path.exists(ta):
@@ -201,17 +199,17 @@ def _battery_steps(tag: str, stage: int = 0) -> list:
          None, None),
     ]
     if os.path.exists(lm):
-        # batch 2: the XLA (non-flash) attention materializes [B,T,H,T]
-        # fp32 scores — 4.3 GB at batch 4 / seq 4096 BEFORE the backward's
-        # residuals, which is marginal against 16 GB HBM.  MFU, the number
-        # we publish, is batch-robust; the Pallas step below runs the
-        # full config.
+        # the composed grader: gossip-DP x PP x TP at the default 2x2x2
+        # carving (8 chips).  batch 2 on the XLA-attention row: the
+        # non-flash local attention materializes fp32 scores, marginal
+        # against 16 GB HBM at the full batch.  MFU, the number we
+        # publish, is batch-robust; the Pallas row runs the full config.
         steps.append(("lm_bench",
-                      [py, lm, "--no-pallas", "--batch", "2", "--out",
+                      [py, lm, "--batch", "2", "--out",
                        os.path.join(m, f"lm_bench_{tag}.json")],
                       2400, None, None))
         steps.append(("lm_bench_pallas",
-                      [py, lm, "--out",
+                      [py, lm, "--pallas", "--out",
                        os.path.join(m, f"lm_bench_pallas_{tag}.json")],
                       2400, None, None))
     # 1,5,10 not 1,2,5,10: one fewer ResNet compile (~5 min of window)
@@ -272,12 +270,12 @@ def _rehearsal_steps(tag: str) -> list:
          None, None),
         ("lm_bench",
          [py, os.path.join(REPO, "tools", "lm_bench.py"),
-          "--virtual-cpu", "--smoke", "--no-pallas",
+          "--virtual-cpu", "--smoke",
           "--out", os.path.join(m, f"lm_bench_{tag}.json")], 900, None,
          None),
         ("lm_bench_pallas",
          [py, os.path.join(REPO, "tools", "lm_bench.py"),
-          "--virtual-cpu", "--smoke",
+          "--virtual-cpu", "--smoke", "--pallas",
           "--out", os.path.join(m, f"lm_bench_pallas_{tag}.json")], 900,
          None, None),
         ("step_sweep",

@@ -1,24 +1,34 @@
-"""Transformer-LM benchmark: tokens/sec + MFU for the ring-SP/Pallas path.
+"""End-to-end grader for the decentralized LLM at production shape.
 
-The repo's beyond-reference surface (ring attention, zigzag layout, Pallas
-flash kernels — SURVEY.md §5 long-context) gets its own measured number
-beside the ResNet headline (bench.py).  A GPT-style ``RingTransformerLM``
-trains on synthetic tokens with Adam; the measurement is the steady-state
-training step, ``lax.scan``-batched ``--steps-per-call`` deep so one
-host->device dispatch covers several optimizer steps (the tunnel's
-dispatch latency otherwise dominates, see tools/chip_calibrate.py).
+Trains the composed transformer — gossip-DP x pipeline x tensor x Ulysses
+on ONE mesh (``bluefog_tpu.parallel.compose``) — through the full step
+machinery (buffer donation, ``adapt_with_combine(delayed=True)`` pipelined
+gossip, fused ``--steps-per-call``, chaos/flight instrumentation, retrace
+sentinel) and grades it on every axis the paper's claim rides on:
 
-On the single axon chip the ring is degenerate (n=1) but the Pallas
-flash-attention kernel compiles through Mosaic and does the real work —
-that is the number the battery wants.  On a pod slice the sequence shards
-across the mesh and the same script measures true ring-SP throughput.
+* **per-step time / tokens-per-sec / MFU** against the trusted roofline
+  ceiling (``bench._peak_flops``; null off-TPU);
+* **overlap fraction** of the gossip permutes under compute, via a
+  ``jax.profiler`` trace fed to tools/trace_analyze (null when the
+  platform emits no usable device track — CPU fallback);
+* **ICI-vs-DCN byte attribution** from pre-optimization StableHLO
+  (``utils.hlo_bytes.stablehlo_wire_stats``): gossip permutes are the
+  only cross-slice traffic and carry the wire codec; PP/TP/SP
+  collectives stay intra-slice at the compute dtype;
+* **DCN wire sweep**: the same carving AOT-lowered at f32 / bf16 /
+  fp8@64 gossip codecs, pinning the bytes each buys;
+* **invariants**: donation intact after the run, retrace sentinel 0
+  after warmup;
+* optional **chaos**: ``--chaos 'throttle:...'`` injects a straggler whose
+  flight bundle (``--flight-dir``) tools/postmortem.py must blame
+  correctly — the tier-1 test drives exactly that.
 
-MFU uses the standard analytic convention (PaLM appendix-B shape):
-``train FLOPs/token = 6·N_params + 6·L·d_model·T`` (the attention term
-halved for causal masking); XLA's cost-analysis count is reported
-alongside as ``xla_call_flops``.
+Emits a ``bluefog-lm-bench-1`` JSON artifact (last stdout line, and
+``--out``).  ``--aot-only`` skips execution and fills the byte/codec
+fields only — the CPU AOT proofs (tests/test_lm_bench.py) use it to pin
+that cross-slice gossip bytes follow DP-leader degree, not rank count.
 
-Run:    python tools/lm_bench.py --out docs/measured/lm_bench_r05.json
+Run:    python tools/lm_bench.py --dp 4 --pp 2 --tp 2 --wire fp8@64 --out ...
 Smoke:  python tools/lm_bench.py --virtual-cpu --smoke
 """
 import argparse
@@ -26,15 +36,18 @@ import importlib.util
 import json
 import os
 import sys
+import tempfile
 import time
 
 REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
 sys.path.insert(0, REPO)
 
+SCHEMA = "bluefog-lm-bench-1"
 
-def _load_bench():
+
+def _load_tool(name):
     spec = importlib.util.spec_from_file_location(
-        "bench_mod", os.path.join(REPO, "bench.py"))
+        name + "_mod", os.path.join(REPO, name + ".py"))
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     return mod
@@ -43,51 +56,57 @@ def _load_bench():
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--virtual-cpu", action="store_true",
-                    help="8-device virtual CPU mesh (smoke/testing)")
+                    help="virtual CPU mesh sized dp*pp*tp*sp (smoke/tests)")
     ap.add_argument("--smoke", action="store_true",
                     help="tiny shapes for CI (implies quick compile)")
+    ap.add_argument("--dp", type=int, default=2, help="gossip-DP replicas")
+    ap.add_argument("--pp", type=int, default=2, help="pipeline stages")
+    ap.add_argument("--tp", type=int, default=2, help="tensor-parallel ways")
+    ap.add_argument("--sp", type=int, default=1, help="Ulysses sequence ways")
+    ap.add_argument("--wire", default=None,
+                    help="gossip DCN codec (bf16 / fp8 / fp8@64 / int8@...)")
     ap.add_argument("--seq", type=int, default=None,
-                    help="global sequence length (default 4096; smoke 256)")
+                    help="global sequence length (default 2048; smoke 32)")
     ap.add_argument("--layers", type=int, default=None)
     ap.add_argument("--d-model", type=int, default=None)
     ap.add_argument("--heads", type=int, default=None)
-    ap.add_argument("--batch", type=int, default=None)
+    ap.add_argument("--micro", type=int, default=None,
+                    help="microbatches per step (pipeline fill)")
+    ap.add_argument("--batch", type=int, default=None,
+                    help="per-microbatch batch size")
     ap.add_argument("--vocab", type=int, default=None)
     ap.add_argument("--iters", type=int, default=None)
     ap.add_argument("--steps-per-call", type=int, default=None)
-    ap.add_argument("--sp-layout", default="zigzag",
-                    choices=["contiguous", "zigzag"],
-                    help="ring layout when the mesh has >1 device")
-    ap.add_argument("--no-pallas", action="store_true",
-                    help="pure-XLA attention instead of the flash kernel")
-    ap.add_argument("--no-scan-layers", action="store_true",
-                    help="unrolled layer stack (default scans ONE block "
-                         "over depth: compile time O(1) in --layers, the "
-                         "scarce resource in a tunnel window)")
-    ap.add_argument("--remat", action="store_true",
-                    help="rematerialize blocks (nothing_saveable): only "
-                         "layer inputs survive to the backward — required "
-                         "for long-context configs whose per-layer "
-                         "residuals would not fit HBM")
+    ap.add_argument("--no-delayed", action="store_true",
+                    help="bulk-synchronous gossip instead of the pipelined "
+                         "one-step-delayed mixing (kills the overlap)")
+    ap.add_argument("--remat", action="store_true")
+    ap.add_argument("--pallas", action="store_true",
+                    help="flash (Pallas) local attention inside ulysses "
+                         "instead of the XLA reference path")
+    ap.add_argument("--no-trace", action="store_true",
+                    help="skip the profiler trace / overlap grading")
+    ap.add_argument("--no-sweep", action="store_true",
+                    help="skip the wire-codec AOT sweep")
+    ap.add_argument("--aot-only", action="store_true",
+                    help="lower + attribute bytes, never execute (fast "
+                         "CPU proof mode)")
+    ap.add_argument("--chaos", default=None,
+                    help="fault spec, e.g. 'throttle:from=2,until=99,"
+                         "t=0.05,rank=5'")
+    ap.add_argument("--flight-dir", default=None,
+                    help="dump the flight bundle here after the run")
     ap.add_argument("--out", default=None, help="json artifact path")
     ap.add_argument("--allow-cpu", action="store_true")
     args = ap.parse_args()
 
-    smoke = args.smoke or args.virtual_cpu
-    seq = args.seq or (256 if smoke else 4096)
-    layers = args.layers or (2 if smoke else 12)
-    d_model = args.d_model or (64 if smoke else 1024)
-    heads = args.heads or (2 if smoke else 16)
-    batch = args.batch or (1 if smoke else 4)
-    vocab = args.vocab or (64 if smoke else 32768)
-    iters = args.iters or (2 if smoke else 5)
-    steps_per_call = args.steps_per_call or (1 if smoke else 4)
-
+    n_chips = args.dp * args.pp * args.tp * args.sp
     if args.virtual_cpu:
         flags = os.environ.get("XLA_FLAGS", "")
         if "host_platform_device_count" not in flags:
             os.environ["XLA_FLAGS"] = (
-                flags + " --xla_force_host_platform_device_count=8").strip()
+                flags + f" --xla_force_host_platform_device_count="
+                f"{n_chips}").strip()
     import jax
     if args.virtual_cpu:
         jax.config.update("jax_platforms", "cpu")
@@ -96,145 +115,216 @@ def main():
     enable_compilation_cache()
 
     dev = jax.devices()[0]
+    on_tpu = jax.default_backend() == "tpu"
     if dev.platform == "cpu" and not (args.virtual_cpu or args.allow_cpu):
         print("refusing: no accelerator (pass --virtual-cpu or --allow-cpu)",
               file=sys.stderr)
         sys.exit(2)
 
-    import jax.numpy as jnp
+    smoke = args.smoke or (args.virtual_cpu and not on_tpu)
+    seq = args.seq or (32 if smoke else 2048)
+    layers = args.layers or (args.pp * (1 if smoke else 2))
+    d_model = args.d_model or (32 if smoke else 1024)
+    heads = args.heads or (4 if smoke else 16)
+    micro = args.micro or (max(2 * args.pp, 2) if smoke else 4 * args.pp)
+    batch = args.batch or (2 if smoke else 4)
+    vocab = args.vocab or (64 if smoke else 32768)
+    iters = args.iters or (4 if smoke else 8)
+    steps_per_call = args.steps_per_call or (1 if smoke else 4)
+
     import numpy as np
     import optax
-    from jax import lax
-    from jax.sharding import PartitionSpec as P
     import bluefog_tpu as bf
-    from bluefog_tpu import models
+    import bluefog_tpu.optimizers as bfopt
+    from bluefog_tpu.parallel import compose
+    from bluefog_tpu.utils import chaos as bfchaos
+    from bluefog_tpu.utils import flight as bfflight
+    from bluefog_tpu.utils import metrics as bfm
+    from bluefog_tpu.utils.hlo_bytes import stablehlo_wire_stats
+    from bluefog_tpu import diagnostics as bfdiag
 
     bf.init(platform="cpu" if args.virtual_cpu else None)
-    n = bf.size()
-    if seq % n:
+    if bf.size() != n_chips:
         raise SystemExit(
-            f"--seq ({seq}) must be a multiple of the device count ({n})")
-    local_T = seq // n
-    on_tpu = jax.default_backend() == "tpu"
-    use_pallas = (not args.no_pallas) and on_tpu
-    layout = args.sp_layout if n > 1 else "contiguous"
-    if layout == "zigzag" and local_T % 2:
-        layout = "contiguous"
+            f"carving dp*pp*tp*sp = {n_chips} != device count {bf.size()}")
 
-    lm = models.RingTransformerLM(
-        vocab_size=vocab, num_layers=layers, num_heads=heads,
-        d_model=d_model, max_seq_len=seq, axis="rank" if n > 1 else None,
-        dtype=jnp.bfloat16, sp_mode="ring", sp_layout=layout, rope=True,
-        use_pallas=use_pallas, scan_layers=not args.no_scan_layers,
-        remat=args.remat)
-    # init on the dense unparallel clone: the attention holds no params,
-    # and running the flash kernel eagerly here would burn a Mosaic
-    # compile (tunnel-minutes) on a shape-only computation
-    params = lm.clone(axis=None, use_pallas=False).init(
-        jax.random.key(0), jnp.zeros((1, local_T), jnp.int32))
-    n_params = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
+    m = compose.compose_parallelism(
+        args.dp, args.pp, args.tp, args.sp, wire=args.wire)
+    cfg = compose.LMConfig(
+        vocab=vocab, d_model=d_model, heads=heads, layers=layers,
+        seq_len=seq, micro=micro, batch=batch)
+    cfg.validate(m)
 
-    opt = optax.adamw(3e-4)
-    opt_state = opt.init(params)
+    def build_step(mesh3d):
+        grad_fn = compose.make_lm_grad_fn(cfg, mesh3d, remat=args.remat,
+                                          use_pallas=args.pallas)
+        return compose.make_train_step(
+            mesh3d, grad_fn, optax.adam(5e-3),
+            delayed=not args.no_delayed,
+            steps_per_call=steps_per_call,
+            reuse_batch=steps_per_call > 1,
+            metrics_every_k=2, metrics_warmup=2)
 
-    def one_step(params, opt_state, tokens, targets):
-        if n > 1:
-            idx = lax.axis_index("rank")
-            positions = (bf.ops.zigzag_positions(idx, n, local_T // 2)
-                         if layout == "zigzag" else
-                         idx * local_T + jnp.arange(local_T))
-        else:
-            positions = jnp.arange(local_T)
+    step, strategy = build_step(m)
+    params = compose.init_lm_params(cfg, m)
+    state = bfopt.init_distributed(strategy, params)
+    toks = compose.make_lm_batch(cfg, m)
+    params = compose.device_put(m, params)
 
-        def loss_fn(p):
-            logits = lm.apply(p, tokens, positions=positions)
-            return optax.softmax_cross_entropy_with_integer_labels(
-                logits, targets).mean()
+    # -- AOT byte attribution (pre-opt StableHLO: states the wire dtypes
+    #    honestly even where the CPU backend would constant-fold the cast)
+    shlo = step.lower(params, state, toks).as_text()
+    wire_bytes = stablehlo_wire_stats(shlo, m.slice_size)
+    wire_bytes["slice_size"] = m.slice_size
 
-        loss, grads = jax.value_and_grad(loss_fn)(params)
-        if n > 1:
-            grads = jax.tree.map(lambda g: lax.psum(g, "rank"), grads)
-            loss = lax.pmean(loss, "rank")
-        updates, opt_state = opt.update(grads, opt_state, params)
-        return optax.apply_updates(params, updates), opt_state, loss
+    sweep = []
+    if not args.no_sweep and m.dp > 1:
+        codecs = [None, "bf16", "fp8@64"]
+        if args.wire and args.wire not in codecs:
+            codecs.append(args.wire)
+        for w in codecs:
+            mw = compose.compose_parallelism(
+                args.dp, args.pp, args.tp, args.sp, wire=w)
+            sw_step, sw_strategy = build_step(mw)
+            sw_state = bfopt.init_distributed(
+                sw_strategy, jax.tree.map(np.asarray, params))
+            st = stablehlo_wire_stats(
+                sw_step.lower(params, sw_state, toks).as_text(),
+                mw.slice_size)
+            sweep.append({"wire": w, "dcn_bytes": st["dcn_bytes"],
+                          "dcn_dtypes": st["dcn_dtypes"],
+                          "ici_bytes": st["ici_bytes"]})
+        compose.compose_parallelism(       # restore the graded carving as
+            args.dp, args.pp, args.tp, args.sp, wire=args.wire)  # active
 
-    def k_steps(params, opt_state, tokens, targets):
-        def body(carry, _):
-            p, s = carry
-            p, s, loss = one_step(p, s, tokens, targets)
-            return (p, s), loss
-        (params, opt_state), losses = lax.scan(
-            body, (params, opt_state), None, length=steps_per_call)
-        return params, opt_state, losses[-1]
-
-    if n > 1:
-        step = jax.jit(jax.shard_map(
-            k_steps, mesh=bf.mesh(),
-            in_specs=(P(), P(), P(None, "rank"), P(None, "rank")),
-            out_specs=(P(), P(), P())))
-    else:
-        step = jax.jit(k_steps)
-
-    rng = np.random.default_rng(0)
-    tokens = jnp.asarray(rng.integers(0, vocab, (batch, seq)), jnp.int32)
-    targets = jnp.roll(tokens, -1, axis=1)
-
-    xla_call_flops = None
-    try:
-        compiled = step.lower(params, opt_state, tokens, targets).compile()
-        ca = compiled.cost_analysis()
-        if isinstance(ca, (list, tuple)):
-            ca = ca[0]
-        f = float(ca.get("flops", 0.0))
-        if f > 0:
-            xla_call_flops = f
-        step = compiled
-    except Exception:
-        pass                                # fall back to the jit path
-
-    params, opt_state, loss = step(params, opt_state, tokens, targets)
-    bf.hard_sync(loss)                      # compile + warm
-
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        params, opt_state, loss = step(params, opt_state, tokens, targets)
-    bf.hard_sync(loss)
-    dt = time.perf_counter() - t0
-
-    tokens_per_step = batch * seq
-    total_tokens = iters * steps_per_call * tokens_per_step
-    tok_per_sec = total_tokens / dt
-    # analytic train FLOPs/token (see module docstring for the convention)
-    flops_per_token = 6 * n_params + 6 * layers * d_model * seq
-    bench = _load_bench()
-    peak = bench._peak_flops(dev.device_kind) if on_tpu else None
-    mfu = (tok_per_sec * flops_per_token / (peak * n)) if peak else None
-
+    tokens_per_step = args.dp * micro * batch * seq
+    flops_per_token = cfg.flops_per_token()
     doc = {
-        "metric": "transformer_lm_tokens_per_sec",
-        "value": round(tok_per_sec, 1),
-        "unit": "tok/s",
+        "schema": SCHEMA,
         "ok": True,
         "on_accelerator": on_tpu,
         "device": dev.device_kind,
-        "n_chips": n,
-        "mfu": round(mfu, 4) if mfu is not None else None,
+        "mesh": m.describe(),
         "config": {"seq": seq, "layers": layers, "d_model": d_model,
-                   "heads": heads, "batch": batch, "vocab": vocab,
-                   "n_params": n_params, "sp_layout": layout,
-                   "use_pallas": use_pallas,
-                   "scan_layers": not args.no_scan_layers,
-                   "remat": args.remat,
+                   "heads": heads, "micro": micro, "batch": batch,
+                   "vocab": vocab, "n_params": cfg.n_params,
+                   "remat": args.remat, "pallas": args.pallas,
+                   "delayed": not args.no_delayed,
                    "steps_per_call": steps_per_call, "iters": iters},
-        "flops_per_token": flops_per_token,
-        "xla_call_flops": xla_call_flops,
-        "final_loss": float(loss),
+        "wire_bytes": wire_bytes,
+        "wire_sweep": sweep,
+        "per_step_s": None,
+        "tokens_per_sec": None,
+        "mfu": {"flops_per_token": flops_per_token,
+                "model_flops_per_sec": None,
+                "peak_flops_per_chip": None, "mfu": None},
+        "overlap": None,
+        "invariants": None,
+        "losses": None,
+        "loss_decreased": None,
+        "chaos": args.chaos,
+        "straggler": None,
+        "flight_bundle": None,
     }
-    print(json.dumps(doc))
-    if args.out:
-        os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
-        with open(args.out, "w") as f:
+
+    if args.aot_only:
+        _emit(doc, args.out)
+        return
+
+    # -- live run -----------------------------------------------------------
+    if args.chaos:
+        bfchaos.install(args.chaos)
+    donation_probe = jax.tree.leaves(params)[0]
+
+    losses = []
+
+    def run(k):
+        nonlocal params, state
+        for _ in range(k):
+            params, state, loss = step(params, state, toks)
+            losses.append(float(np.asarray(loss).mean()))
+
+    run(2)                                   # compile + warm, arms sentinel
+    trace_dir = None
+    if not args.no_trace:
+        trace_dir = tempfile.mkdtemp(prefix="lm_bench_trace_")
+        with jax.profiler.trace(trace_dir):
+            t0 = time.perf_counter()
+            run(iters)
+            bf.hard_sync(params)
+            dt = time.perf_counter() - t0
+    else:
+        t0 = time.perf_counter()
+        run(iters)
+        bf.hard_sync(params)
+        dt = time.perf_counter() - t0
+
+    per_step = dt / (iters * steps_per_call)
+    tok_per_sec = tokens_per_step / per_step
+    bench = _load_tool("bench")
+    peak = bench._peak_flops(dev.device_kind) if on_tpu else None
+    doc["per_step_s"] = round(per_step, 6)
+    doc["tokens_per_sec"] = round(tok_per_sec, 1)
+    doc["mfu"] = {
+        "flops_per_token": flops_per_token,
+        "model_flops_per_sec": round(tok_per_sec * flops_per_token, 1),
+        "peak_flops_per_chip": peak,
+        "mfu": (round(tok_per_sec * flops_per_token / (peak * n_chips), 4)
+                if peak else None),
+    }
+
+    if trace_dir is not None:
+        try:
+            spec = importlib.util.spec_from_file_location(
+                "trace_analyze_mod",
+                os.path.join(REPO, "tools", "trace_analyze.py"))
+            ta = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(ta)
+            rep = ta.analyze(ta.load_events(ta.find_trace_file(trace_dir)))
+            doc["overlap"] = ({"overlap_fraction": rep["overlap_fraction"],
+                               "comm_ms": rep["comm_ms"],
+                               "comm_exposed_ms": rep["comm_exposed_ms"]}
+                              if rep.get("ok") else None)
+        except Exception as e:              # CPU traces often lack device
+            doc["overlap"] = None           # tracks; the field stays null
+            print(f"[lm_bench] overlap grading unavailable: {e}",
+                  file=sys.stderr)
+
+    doc["losses"] = [round(losses[0], 4), round(losses[-1], 4)]
+    doc["loss_decreased"] = losses[-1] < losses[0]
+    doc["invariants"] = {
+        "donated": True,
+        "donation_intact": bool(donation_probe.is_deleted()),
+        "retraces_after_warmup":
+            int(bfm.counter("bluefog_retrace_after_warmup_total").total()),
+    }
+    doc["ok"] = bool(doc["loss_decreased"]
+                     and doc["invariants"]["donation_intact"]
+                     and doc["invariants"]["retraces_after_warmup"] == 0)
+
+    if args.chaos:
+        stragglers = bfdiag.detect_stragglers()
+        table = bfdiag.last_step_times()
+        doc["straggler"] = {
+            "detected_ranks": [int(r) for r in stragglers],
+            "step_times_s": ([round(float(t), 4) for t in table]
+                             if table is not None else None),
+        }
+    if args.flight_dir:
+        os.makedirs(args.flight_dir, exist_ok=True)
+        doc["flight_bundle"] = bfflight.dump(
+            os.path.join(args.flight_dir, "flight_rank0.json"),
+            reason="lm_bench")
+
+    _emit(doc, args.out)
+
+
+def _emit(doc, out):
+    if out:
+        os.makedirs(os.path.dirname(out) or ".", exist_ok=True)
+        with open(out, "w") as f:
             json.dump(doc, f, indent=1)
+    print(json.dumps(doc))
 
 
 if __name__ == "__main__":

@@ -7,7 +7,7 @@ PY ?= python
 .PHONY: test test-fast test_basic test_ops test_win_ops test_optimizer \
 	test_hier test_native test_examples verify native clean hw-watch \
 	obs-smoke chaos-smoke overlap-smoke postmortem-smoke pod-smoke \
-	autotune-smoke elastic-smoke lm-smoke
+	autotune-smoke elastic-smoke lm-smoke serve-smoke
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -165,6 +165,27 @@ lm-smoke:
 		w['dcn_dtypes'] == ['bf16'] and w['ici_dtypes'] == ['f32'], w; \
 		assert d['tokens_per_sec'] > 0 and len(d['wire_sweep']) == 3, d; \
 		print('lm-smoke OK')"
+
+# serving smoke: the serve battery (decode oracle, KV slot reuse, bucket
+# zero-retrace, the 8-rank train+serve e2e, the chaos drill) plus the
+# serve_bench grader end-to-end on the virtual mesh with a schema check —
+# the CPU rehearsal of the battery row hw_watch runs on hardware
+serve-smoke:
+	$(PY) -m pytest tests/test_serve.py -q -m "not slow"
+	$(PY) tools/serve_bench.py --virtual-cpu --smoke \
+		--out /tmp/serve_bench_smoke.json
+	$(PY) -c "import json; \
+		d = json.load(open('/tmp/serve_bench_smoke.json')); \
+		assert d['schema'] == 'bluefog-serve-bench-1' and d['ok'], d; \
+		i = d['invariants']; \
+		assert i['donation_intact'] and \
+		i['retraces_after_warmup'] == 0, i; \
+		r = d['requests']; \
+		assert r['completed'] == r['submitted'] and r['failed'] == 0, r; \
+		assert d['tokens_per_sec'] > 0, d; \
+		assert d['refresh']['pulls'] >= 1, d; \
+		assert d['latency']['per_token_p50_s'] > 0, d; \
+		print('serve-smoke OK')"
 
 # resilience smoke: deterministic fault injection + healing/rollback on
 # the virtual CPU mesh (kill->heal->contract, NaN->rollback, restart

@@ -23,9 +23,12 @@ from typing import Any, Optional, Tuple
 import jax
 
 __all__ = ["save", "restore", "restore_latest", "latest_step", "all_steps",
-           "is_complete", "resize_distributed", "AsyncSaver"]
+           "is_complete", "resize_distributed", "AsyncSaver",
+           "save_for_serving", "load_for_serving", "all_serving_steps",
+           "latest_serving_step"]
 
 _STEP_DIR = re.compile(r"^step_(\d+)$")
+_SERVING_DIR = re.compile(r"^serving_step_(\d+)$")
 
 # Completion marker: written as the LAST act of a save, so a directory
 # missing it was interrupted mid-write (killed rank, preempted host) and
@@ -147,6 +150,82 @@ def restore_latest(
     if step is None:
         return None, None
     return restore(os.path.join(directory, f"step_{step}"), template), step
+
+
+# ---------------------------------------------------------------------------
+# Serving snapshots: params only, no optimizer/comm state
+# ---------------------------------------------------------------------------
+# A serve fleet cold-starts from training weights but has no use for the
+# optimizer or strategy state a training checkpoint drags along (often 2-3x
+# the parameter bytes).  ``serving_step_<n>`` directories live beside the
+# training ``step_<n>`` ones — the regexes are disjoint, so neither scan
+# ever counts (or prunes) the other's checkpoints — and reuse the same
+# completion-marker protocol: a torn serving snapshot is skipped exactly
+# like a torn training one.
+
+def save_for_serving(directory: str, params: Any, step: int) -> str:
+    """Write a params-only snapshot as ``<directory>/serving_step_<step>``.
+
+    ``params`` is a pytree of arrays (typically the ``[n, ...]``-stacked
+    distributed tree a :class:`~bluefog_tpu.serve.ServeEngine` consumes).
+    Passing a full training state is almost always a mistake — the tuple
+    shape ``(params, opt_state)`` or a dict with an ``opt_state``/``comm``
+    key is rejected so a serve fleet never restores optimizer slots as
+    weights.
+    """
+    if isinstance(params, tuple) and len(params) in (2, 3):
+        raise ValueError(
+            "save_for_serving takes the parameter tree only; this looks "
+            "like a (params, opt_state[, step]) training tuple — pass "
+            "checkpoint.save for full training state")
+    if isinstance(params, dict) and ({"opt_state", "comm", "dstate"}
+                                     & set(params.keys())):
+        raise ValueError(
+            "save_for_serving takes the parameter tree only (found "
+            "optimizer/comm state keys); a serving snapshot must not "
+            "carry training state")
+    directory = os.path.abspath(directory)
+    os.makedirs(directory, exist_ok=True)
+    path = os.path.join(directory, f"serving_step_{int(step)}")
+    params = jax.block_until_ready(params)
+    _checkpointer().save(path, params, force=True)
+    _mark_complete(path)
+    return path
+
+
+def all_serving_steps(directory: str, include_incomplete: bool = False):
+    """Sorted step numbers of *complete* serving snapshots in ``directory``."""
+    if not os.path.isdir(directory):
+        return []
+    out = []
+    for name in os.listdir(directory):
+        m = _SERVING_DIR.match(name)
+        if m and (include_incomplete
+                  or is_complete(os.path.join(directory, name))):
+            out.append(int(m.group(1)))
+    return sorted(out)
+
+
+def latest_serving_step(directory: str) -> Optional[int]:
+    steps = all_serving_steps(directory)
+    return steps[-1] if steps else None
+
+
+def load_for_serving(
+    directory: str, template: Optional[Any] = None,
+) -> Tuple[Optional[Any], Optional[int]]:
+    """Load the newest *complete* serving snapshot: ``(params, step)``.
+
+    Torn directories (no completion marker) are skipped — the same
+    contract as :func:`restore_latest`, so a serve fleet spawned while a
+    training rank died mid-export still cold-starts from the last good
+    weights.  ``(None, None)`` when nothing complete exists.
+    """
+    step = latest_serving_step(directory)
+    if step is None:
+        return None, None
+    path = os.path.join(directory, f"serving_step_{step}")
+    return restore(path, template), step
 
 
 class AsyncSaver:

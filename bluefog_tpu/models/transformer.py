@@ -37,6 +37,42 @@ def apply_rope(x: jax.Array, positions: jax.Array,
         [x1 * cos - x2 * sin, x1 * sin + x2 * cos], -1).astype(x.dtype)
 
 
+def apply_rope_rows(x: jax.Array, positions: jax.Array,
+                    base: float = 10000.0) -> jax.Array:
+    """Rotary position embedding on ``[B, H, D]`` with PER-ROW ``positions``
+    ([B] int) — the decode hot path, where each batched request sits at its
+    own sequence offset.  Same channel pairing and f32 internals as
+    :func:`apply_rope`, so a token roped here matches the one roped during
+    prefill bit-for-bit."""
+    d = x.shape[-1]
+    if d % 2:
+        raise ValueError(f"rope needs an even head_dim, got {d}: the "
+                         "rotation pairs channel i with channel i + d//2")
+    half = d // 2
+    freqs = base ** (-jnp.arange(half, dtype=jnp.float32) / half)
+    ang = positions.astype(jnp.float32)[:, None] * freqs[None]     # [B, half]
+    cos = jnp.cos(ang)[:, None, :]
+    sin = jnp.sin(ang)[:, None, :]
+    x1, x2 = x[..., :half].astype(jnp.float32), x[..., half:].astype(jnp.float32)
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], -1).astype(x.dtype)
+
+
+def init_decode_cache(model: "RingTransformerLM", batch: int, max_len: int,
+                      dtype: Any = None):
+    """Fresh per-layer KV cache for :meth:`RingTransformerLM.__call__`'s
+    decode path: a tuple of ``{"k", "v"}`` dicts shaped
+    ``[batch, max_len, num_kv_heads, head_dim]`` (grouped-query aware —
+    the cache holds the COMPACT kv heads, G x smaller than the q heads)."""
+    Hkv = model.num_kv_heads or model.num_heads
+    Dh = model.d_model // model.num_heads
+    dt = model.dtype if dtype is None else dtype
+    return tuple(
+        {"k": jnp.zeros((batch, max_len, Hkv, Dh), dt),
+         "v": jnp.zeros((batch, max_len, Hkv, Dh), dt)}
+        for _ in range(model.num_layers))
+
+
 class RingTransformerBlock(nn.Module):
     """Pre-LN decoder block; attention is ring-parallel when ``axis`` is set."""
     num_heads: int
@@ -55,7 +91,7 @@ class RingTransformerBlock(nn.Module):
     scan_compat: bool = False           # return (x, None) for nn.scan
 
     @nn.compact
-    def __call__(self, x, positions=None):
+    def __call__(self, x, positions=None, cache=None):
         # x: [batch, local_seq, d_model]
         B, T, C = x.shape
         H = self.num_heads
@@ -82,6 +118,44 @@ class RingTransformerBlock(nn.Module):
                 raise ValueError("rope needs the tokens' global positions")
             q = apply_rope(q, positions)
             k = apply_rope(k, positions)
+        if cache is not None:
+            # decode step: append this chunk's compact kv at pos_offset
+            # (= positions[0]) and attend over everything written so far.
+            # Attention numerics mirror dense_attention exactly (f32
+            # scores, scale folded into q, -inf masking) so a token
+            # decoded here is logit-identical to the full forward.
+            if self.axis is not None:
+                raise ValueError(
+                    "decode with a KV cache is a single-device path; the "
+                    "serve engine handles PP/TP sharding itself "
+                    "(bluefog_tpu.serve.engine)")
+            offset = positions[0]
+            ck = jax.lax.dynamic_update_slice(
+                cache["k"], k.astype(cache["k"].dtype), (0, offset, 0, 0))
+            cv = jax.lax.dynamic_update_slice(
+                cache["v"], v.astype(cache["v"].dtype), (0, offset, 0, 0))
+            new_cache = {"k": ck, "v": cv}
+            if Hkv != H:
+                ck = jnp.repeat(ck, H // Hkv, axis=2)
+                cv = jnp.repeat(cv, H // Hkv, axis=2)
+            L = ck.shape[1]
+            ct = jnp.promote_types(q.dtype, jnp.float32)
+            s = jnp.einsum("bthd,bshd->bths",
+                           q.astype(ct) * (Dh ** -0.5),
+                           ck.astype(ct))
+            valid = (jnp.arange(L)[None, :]
+                     <= (offset + jnp.arange(T))[:, None])       # [T, L]
+            s = jnp.where(valid[None, :, None, :], s, -jnp.inf)
+            p = jax.nn.softmax(s, axis=-1)
+            att = jnp.einsum("bths,bshd->bthd", p,
+                             cv.astype(ct)).astype(q.dtype)
+            att = att.astype(self.dtype).reshape(B, T, C)
+            x = x + nn.Dense(C, use_bias=False, dtype=self.dtype)(att)
+            h = nn.LayerNorm(dtype=jnp.float32)(x).astype(self.dtype)
+            h = nn.Dense(self.mlp_ratio * C, dtype=self.dtype)(h)
+            h = nn.gelu(h)
+            x = x + nn.Dense(C, dtype=self.dtype)(h)
+            return x, new_cache
         if self.sp_mode not in ("ring", "ulysses"):
             raise ValueError(
                 f"unknown sp_mode {self.sp_mode!r}; choose 'ring' or "
@@ -161,11 +235,22 @@ class RingTransformerLM(nn.Module):
                                 # unrolled loop's per-layer modules).
 
     @nn.compact
-    def __call__(self, tokens, pos_offset=0, positions=None):
+    def __call__(self, tokens, pos_offset=0, positions=None, cache=None):
         """``positions`` ([T] int32 global positions) overrides the
         contiguous ``pos_offset + arange`` — required for the zigzag
         layout, where a device's tokens are two non-adjacent chunks
-        (:func:`bluefog_tpu.ops.zigzag_positions`)."""
+        (:func:`bluefog_tpu.ops.zigzag_positions`).
+
+        ``cache`` switches to the DECODE path: ``tokens`` is the next chunk
+        (typically ``[B, 1]``), ``pos_offset`` the number of tokens already
+        in the cache (traced scalars are fine), and the per-layer kv of the
+        chunk is appended at ``pos_offset`` (see :func:`init_decode_cache`).
+        Returns ``(logits, new_cache)`` instead of logits; proven
+        logit-identical to the full forward by the float64 oracle in
+        tests/test_serve.py.  Single-device only (``axis=None``,
+        ``scan_layers=False``) — the sharded serving path lives in
+        :mod:`bluefog_tpu.serve`.
+        """
         B, T = tokens.shape
         x = nn.Embed(self.vocab_size, self.d_model,
                      dtype=self.dtype)(tokens)
@@ -175,6 +260,19 @@ class RingTransformerLM(nn.Module):
             pos = nn.Embed(self.max_seq_len, self.d_model, dtype=self.dtype)(
                 positions)
             x = x + pos[None]
+        if cache is not None:
+            if self.scan_layers:
+                raise ValueError(
+                    "decode with a KV cache needs per-layer modules; "
+                    "scan_layers=True folds them into one scanned block")
+            if self.axis is not None:
+                raise ValueError(
+                    "decode with a KV cache is a single-device path; the "
+                    "serve engine handles sharding (bluefog_tpu.serve)")
+            if len(cache) != self.num_layers:
+                raise ValueError(
+                    f"cache has {len(cache)} layer entries, model has "
+                    f"{self.num_layers} (init_decode_cache builds one)")
         if self.remat:
             # prevent_cse only matters OUTSIDE lax.scan (scan already
             # blocks the CSE it guards against); leaving it on inside the
@@ -192,6 +290,7 @@ class RingTransformerLM(nn.Module):
             sp_mode=self.sp_mode, sp_layout=self.sp_layout,
             rope=self.rope, use_pallas=self.use_pallas,
             pallas_interpret=self.pallas_interpret)
+        new_cache = []
         if self.scan_layers:
             ScanStack = nn.scan(
                 Block, variable_axes={"params": 0},
@@ -199,9 +298,14 @@ class RingTransformerLM(nn.Module):
                 length=self.num_layers)
             x, _ = ScanStack(**kw, scan_compat=True,
                              name="blocks")(x, positions)
+        elif cache is not None:
+            for i in range(self.num_layers):
+                x, layer_cache = Block(**kw)(x, positions, cache=cache[i])
+                new_cache.append(layer_cache)
         else:
             for _ in range(self.num_layers):
                 x = Block(**kw)(x, positions)
         x = nn.LayerNorm(dtype=jnp.float32)(x)
-        return nn.Dense(self.vocab_size, use_bias=False,
-                        dtype=jnp.float32)(x)
+        logits = nn.Dense(self.vocab_size, use_bias=False,
+                          dtype=jnp.float32)(x)
+        return logits if cache is None else (logits, tuple(new_cache))

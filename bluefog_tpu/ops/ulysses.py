@@ -100,15 +100,18 @@ def dense_attention(q, k, v, causal: bool, scale: Optional[float] = None):
     tests and the single-device fallback in the transformer block."""
     if scale is None:
         scale = 1.0 / np.sqrt(q.shape[-1])
-    s = jnp.einsum("bihd,bjhd->bihj", q.astype(jnp.float32) * scale,
-                   k.astype(jnp.float32))
+    # f32 floor; float64 inputs (the x64 oracles) keep full precision so a
+    # decode-vs-forward comparison can be pinned at 1e-9, not f32 rounding
+    ct = jnp.promote_types(q.dtype, jnp.float32)
+    s = jnp.einsum("bihd,bjhd->bihj", q.astype(ct) * scale,
+                   k.astype(ct))
     if causal:
         T, Tk = q.shape[1], k.shape[1]
         mask = jnp.arange(T)[:, None] >= jnp.arange(Tk)[None, :]
         s = jnp.where(mask[:, None, :][None], s, -jnp.inf)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bihj,bjhd->bihd", p,
-                      v.astype(jnp.float32)).astype(q.dtype)
+                      v.astype(ct)).astype(q.dtype)
 
 
 def _chunk_len(Tk: int, max_chunk: int) -> int:

@@ -136,6 +136,22 @@ def win_update(
     return combined, Window(value=combined, recv=recv)
 
 
+def win_pull(x: jax.Array, sched: CommSchedule, *, axis: Axis = "rank",
+             wire: Optional[str] = None) -> jax.Array:
+    """One-shot pull: fresh window, fetch in-neighbors, weighted combine.
+
+    The serve-refresh hot path (:mod:`bluefog_tpu.serve.refresh`): a
+    pull-only leaf keeps no persistent window state between refreshes, so
+    create + get + update collapse into one call.  Ranks with no in-edges
+    and self weight 1 pass their tensor through untouched — the training
+    side of a train→serve pull schedule is a no-op by construction.
+    """
+    win = win_create(x, sched)
+    win = win_get(win, sched, axis=axis, wire=wire)
+    out, _ = win_update(win, sched, axis=axis)
+    return out
+
+
 def win_update_then_collect(
     win: Window, sched: CommSchedule, *, axis: Axis = "rank",
 ) -> Tuple[jax.Array, Window]:

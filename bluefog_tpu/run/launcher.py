@@ -134,6 +134,20 @@ def build_parser() -> argparse.ArgumentParser:
                         "under the system temp dir)")
     p.add_argument("--no-xla-tuning", action="store_true",
                    help="do not add the recommended TPU overlap XLA flags")
+    p.add_argument("--serve", action="store_true",
+                   help="launch in serving mode (sets BLUEFOG_SERVE=1 for "
+                        "the child): the command should bring up a "
+                        "bluefog_tpu.serve engine; with no command, runs "
+                        "the built-in `python -m bluefog_tpu.serve` demo "
+                        "loop")
+    p.add_argument("--serve-buckets", default=None,
+                   help="serving shape buckets '<batch,..>@<prompt_len,..>' "
+                        "e.g. '1,2,4@16,64,256' (sets "
+                        "BLUEFOG_SERVE_BUCKETS; see ServeConfig.from_env)")
+    p.add_argument("--refresh-every", type=int, default=None,
+                   help="serving weight refresh: pull fresh params from "
+                        "the training fleet every N train steps (sets "
+                        "BLUEFOG_REFRESH_EVERY; see serve.WeightRefresher)")
     p.add_argument("--interactive", action="store_true",
                    help="drop into an initialized Python REPL instead of "
                         "running a command (reference: ibfrun). With -np N "
@@ -185,6 +199,12 @@ def _child_env(args) -> dict:
         env["BLUEFOG_METRICS_PORT"] = str(args.metrics_port)
     if args.flight_dir:
         env["BLUEFOG_FLIGHT_DIR"] = os.path.abspath(args.flight_dir)
+    if args.serve:
+        env["BLUEFOG_SERVE"] = "1"
+    if args.serve_buckets:
+        env["BLUEFOG_SERVE_BUCKETS"] = args.serve_buckets
+    if args.refresh_every is not None:
+        env["BLUEFOG_REFRESH_EVERY"] = str(args.refresh_every)
     if not args.no_xla_tuning:
         from ..utils.config import (
             RECOMMENDED_TPU_XLA_FLAGS, looks_like_tpu_environment)
@@ -781,6 +801,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f"bfrun-tpu: scale target {args.scale} written to {path}",
               flush=True)
         return 0
+    if args.serve and not args.command:
+        # serving mode with no command: run the built-in demo loop so the
+        # launcher path is exercisable end to end (serve/__main__.py)
+        args.command = [sys.executable, "-m", "bluefog_tpu.serve"]
     if not args.command:
         build_parser().print_help()
         return 2

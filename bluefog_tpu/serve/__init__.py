@@ -1,0 +1,31 @@
+"""Decentralized inference: continuous batching + live gossip weight refresh.
+
+The serving fleet reuses the training carving
+(:func:`bluefog_tpu.parallel.compose.compose_parallelism`) with the
+gossip-DP axis repurposed as the *replica* axis: each replica holds the
+model PP×TP-sharded intra-slice and decodes its own requests, while
+:class:`WeightRefresher` joins the training topology as a pull-only leaf
+and fetches fresh params mid-traffic — bluefog's one-sided window
+semantics applied to the train→serve boundary.
+
+Surface:
+
+* :class:`ServeEngine` / :class:`ServeConfig` — bucketed prefill/decode
+  over one carving (``engine.py``);
+* :class:`Scheduler` / :class:`Request` — continuous batching between
+  decode steps (``scheduler.py``);
+* :mod:`.kv_cache` — slotted paged KV cache + :class:`SlotAllocator`;
+* :class:`WeightRefresher` — live pulls from a training fleet
+  (``refresh.py``);
+* ``python -m bluefog_tpu.serve`` — the demo loop ``bfrun-tpu --serve``
+  launches by default.
+"""
+from .engine import ServeConfig, ServeEngine
+from .kv_cache import KVCacheConfig, SlotAllocator, init_cache
+from .refresh import WeightRefresher
+from .scheduler import Request, Scheduler
+
+__all__ = [
+    "ServeConfig", "ServeEngine", "KVCacheConfig", "SlotAllocator",
+    "init_cache", "Request", "Scheduler", "WeightRefresher",
+]

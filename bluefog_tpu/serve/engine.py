@@ -1,0 +1,400 @@
+"""Prefill + decode engine over a ``compose_parallelism`` carving.
+
+The carving's gossip-DP axis becomes the **replica** axis: each of the
+``m.dp`` replicas holds the full model PP×TP-sharded intra-slice and
+serves its own stream of requests — no collective ever crosses the
+``rank`` axis at serve time (that axis is reserved for
+:mod:`bluefog_tpu.serve.refresh`, which pulls fresh weights from the
+training fleet through it).  One SPMD program spans all replicas: every
+engine call runs everywhere, and replicas with nothing to do run the
+identical program over their trash slot, which is what keeps the compile
+cache finite and the retrace sentinel at 0.
+
+Shapes are **bucketed**: decode batches only ever have the lane counts in
+``ServeConfig.batch_buckets`` and prompts are padded to the lengths in
+``prefill_buckets``.  :meth:`ServeEngine.warmup` compiles every declared
+bucket up front; afterwards the engine snapshots both jit caches and any
+growth fires :func:`bluefog_tpu.utils.metrics.note_retrace` — the same
+sentinel a training step uses, so one gauge covers the whole fleet.
+
+The KV cache is a donated argument threaded through a ``lax.scan`` decode
+carry (:mod:`.kv_cache` owns the layout); steady-state decode is a single
+cached program per (bucket, steps_per_call): embed → pp-cycle of
+stage-local layer scans (``ppermute`` moves the activation, a stage-id
+``where`` keeps exactly one stage's work) → stage-0 logits ``psum`` →
+greedy argmax, fused over ``decode_steps_per_call`` tokens.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from ..models.transformer import apply_rope, apply_rope_rows
+from ..ops.ulysses import dense_attention
+from ..parallel.compose import AXES, LMConfig, Mesh3D, _ln
+from ..utils import flight as _flight
+from ..utils import metrics as _metrics
+from . import kv_cache as _kv
+
+__all__ = ["ServeConfig", "ServeEngine"]
+
+
+def _parse_buckets(spec: str) -> Tuple[Tuple[int, ...], Tuple[int, ...]]:
+    """``"1,2,4@8,16"`` -> ``((1, 2, 4), (8, 16))`` (batch@prefill)."""
+    try:
+        batch_s, _, prefill_s = spec.partition("@")
+        batch = tuple(int(t) for t in batch_s.split(",") if t.strip())
+        prefill = tuple(int(t) for t in prefill_s.split(",") if t.strip()) \
+            if prefill_s else ()
+    except ValueError as e:
+        raise ValueError(
+            f"BLUEFOG_SERVE_BUCKETS={spec!r}: expected "
+            "'<batch,...>@<prompt_len,...>' (e.g. '1,2,4@8,16')") from e
+    return batch, prefill
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Static serving shapes — everything that pins a compiled program.
+
+    ``batch_buckets``: the only decode lane counts ever traced (ascending);
+    the scheduler rounds its active-lane count up to the smallest bucket
+    that fits and pads the rest with trash lanes.  ``prefill_buckets``:
+    prompt pad lengths, same contract.  ``slots``/``max_len`` size each
+    replica's KV cache; ``decode_steps_per_call`` fuses that many greedy
+    tokens into one program call (admission only happens between calls).
+    """
+    batch_buckets: Tuple[int, ...] = (1, 2, 4)
+    prefill_buckets: Tuple[int, ...] = (8, 16)
+    slots: int = 8
+    max_len: int = 64
+    decode_steps_per_call: int = 1
+    dtype: Any = jnp.float32
+
+    def __post_init__(self):
+        if not self.batch_buckets or not self.prefill_buckets:
+            raise ValueError("declare at least one batch and one prefill "
+                             "bucket — undeclared shapes retrace")
+        for name in ("batch_buckets", "prefill_buckets"):
+            b = getattr(self, name)
+            if tuple(sorted(set(b))) != tuple(b):
+                raise ValueError(f"{name}={b} must be strictly ascending")
+        if self.batch_buckets[-1] > self.slots:
+            raise ValueError(
+                f"largest batch bucket ({self.batch_buckets[-1]}) exceeds "
+                f"slots ({self.slots}); a lane needs a resident slot")
+        if self.prefill_buckets[-1] > self.max_len:
+            raise ValueError(
+                f"largest prefill bucket ({self.prefill_buckets[-1]}) "
+                f"exceeds max_len ({self.max_len})")
+        if self.decode_steps_per_call < 1:
+            raise ValueError("decode_steps_per_call must be >= 1")
+
+    @classmethod
+    def from_env(cls, **overrides) -> "ServeConfig":
+        """Honour ``BLUEFOG_SERVE_BUCKETS='<batch,...>@<prompt_len,...>'``."""
+        spec = os.environ.get("BLUEFOG_SERVE_BUCKETS", "")
+        if spec:
+            batch, prefill = _parse_buckets(spec)
+            overrides.setdefault("batch_buckets", batch)
+            if prefill:
+                overrides.setdefault("prefill_buckets", prefill)
+        return cls(**overrides)
+
+    def batch_bucket_for(self, lanes: int) -> int:
+        """Smallest declared decode bucket that fits ``lanes`` live lanes."""
+        for b in self.batch_buckets:
+            if b >= lanes:
+                return b
+        raise ValueError(f"{lanes} live lanes exceed the largest declared "
+                         f"batch bucket {self.batch_buckets[-1]}")
+
+    def prefill_bucket_for(self, length: int) -> int:
+        """Smallest declared prompt pad length that fits ``length`` tokens."""
+        for b in self.prefill_buckets:
+            if b >= length:
+                return b
+        raise ValueError(f"prompt of {length} tokens exceeds the largest "
+                         f"declared prefill bucket "
+                         f"{self.prefill_buckets[-1]}")
+
+
+class ServeEngine:
+    """SPMD prefill/decode over one carving; host-side shapes per replica.
+
+    ``params`` is the ``[n, ...]``-stacked compose-LM tree
+    (:func:`~bluefog_tpu.parallel.compose.init_lm_params` layout, or a
+    training snapshot via :func:`bluefog_tpu.checkpoint.load_for_serving`).
+    The engine never mutates it — :meth:`update_params` rebinds the whole
+    tree, which is how the refresher swaps weights mid-traffic without a
+    retrace (same shapes, same program).
+    """
+
+    def __init__(self, m: Mesh3D, cfg: LMConfig, params: Any,
+                 scfg: Optional[ServeConfig] = None):
+        if m.sp != 1:
+            raise ValueError(
+                "serving decodes one token at a time; an sp > 1 carving has "
+                "no sequence to shard — fold sp into tp for inference")
+        cfg.validate(m)
+        scfg = scfg or ServeConfig.from_env()
+        if scfg.max_len < scfg.prefill_buckets[-1] + scfg.decode_steps_per_call:
+            raise ValueError("max_len leaves no room to decode past the "
+                             "longest prompt bucket")
+        self.m, self.cfg, self.scfg = m, cfg, scfg
+        self._sharding = NamedSharding(m.mesh, P(AXES))
+        # normalize through the SAME placement path update_params uses, so
+        # a mid-traffic weight swap presents bit-identical shardings to the
+        # jit cache and cannot retrace the warmed buckets
+        self.update_params(params)
+        self.cache_cfg = _kv.KVCacheConfig(
+            layers=cfg.layers // m.pp, slots=scfg.slots,
+            max_len=scfg.max_len, kv_heads=cfg.heads // m.tp,
+            head_dim=cfg.d_model // cfg.heads, dtype=scfg.dtype)
+        # materialize the zero cache THROUGH a shard_map so its sharding is
+        # byte-identical to what the jitted bodies emit — a device_put'd
+        # P(AXES) spec normalizes differently (size-1 axes dropped) and
+        # would retrace every bucket once on its second visit
+        per_dev = (1, self.cache_cfg.layers, scfg.slots + 1, scfg.max_len,
+                   self.cache_cfg.kv_heads, self.cache_cfg.head_dim)
+        self.cache = jax.jit(jax.shard_map(
+            lambda: {"k": jnp.zeros(per_dev, scfg.dtype),
+                     "v": jnp.zeros(per_dev, scfg.dtype)},
+            mesh=m.mesh, in_specs=(), out_specs=P(AXES)))()
+        self._decode_jit = self._build(self._decode_body)
+        self._prefill_jit = self._build(self._prefill_body)
+        self._warm_sizes: Optional[Tuple[int, int]] = None
+
+    # ------------------------------------------------------------------
+    # device-side bodies (per-device shapes, leading [1, ...] sliced off)
+    # ------------------------------------------------------------------
+
+    def _build(self, body):
+        return jax.jit(
+            jax.shard_map(body, mesh=self.m.mesh,
+                          in_specs=P(AXES), out_specs=P(AXES),
+                          check_vma=False),
+            donate_argnums=(1,))
+
+    def _layer_step(self, lp, x, kl, vl, slot_ids, lens):
+        """One decoder block on one new token per lane: ``x`` is ``[S, D]``."""
+        cfg, m = self.cfg, self.m
+        Hl = cfg.heads // m.tp
+        hsz = cfg.d_model // cfg.heads
+        S = x.shape[0]
+        h = _ln(x)
+        q, k, v = jnp.split(h @ lp["wqkv"], 3, axis=-1)
+        q = apply_rope_rows(q.reshape(S, Hl, hsz), lens)
+        k = apply_rope_rows(k.reshape(S, Hl, hsz), lens)
+        v = v.reshape(S, Hl, hsz)
+        kl, vl = _kv.append_rows(kl, vl, slot_ids, lens, k, v)
+        att = _kv.attend_rows(q, kl, vl, slot_ids, lens)
+        x = x + lax.psum(att.reshape(S, Hl * hsz) @ lp["wo"], "tp")
+        h = _ln(x)
+        x = x + lax.psum(jax.nn.gelu(h @ lp["w1"]) @ lp["w2"], "tp")
+        return x, kl, vl
+
+    def _pp_cycle(self, blocks, x, ck, cv, stage_apply):
+        """Cycle ``x`` through all pipeline stages; each stage's layer scan
+        runs everywhere but only the owning stage keeps its activation and
+        cache writes, so the program is identical on every device."""
+        sid = lax.axis_index("stage")
+        for s in range(self.m.pp):
+            y, nk, nv = stage_apply(blocks, x, ck, cv)
+            keep = sid == s
+            x = jnp.where(keep, y, x)
+            ck = jnp.where(keep, nk, ck)
+            cv = jnp.where(keep, nv, cv)
+            x = lax.ppermute(
+                x, "stage",
+                [(i, (i + 1) % self.m.pp) for i in range(self.m.pp)])
+        # pp hops return the last stage's output to stage 0, which alone
+        # holds the valid final activation — psum broadcasts its logits
+        return x, ck, cv, sid
+
+    def _decode_body(self, params, cache, toks, slot_ids, lens):
+        params, cache, toks, slot_ids, lens = jax.tree.map(
+            lambda t: t[0], (params, cache, toks, slot_ids, lens))
+        embed = params["shared"]["embed"]
+        head = params["shared"]["head"]
+        bp = params["blocks"]
+
+        def step(carry, _):
+            toks, lens, ck, cv = carry
+
+            def stage_apply(blocks, x, ck, cv):
+                def one(x, xs):
+                    lp, kl, vl = xs
+                    x, kl, vl = self._layer_step(lp, x, kl, vl, slot_ids,
+                                                 lens)
+                    return x, (kl, vl)
+                x, (nk, nv) = lax.scan(one, x, (blocks, ck, cv))
+                return x, nk, nv
+
+            x = embed[toks]                                   # [S, D]
+            x, ck, cv, sid = self._pp_cycle(bp, x, ck, cv, stage_apply)
+            logits = lax.psum(
+                jnp.where(sid == 0, _ln(x) @ head, 0.0), "stage")
+            nxt = jnp.argmax(logits, axis=-1).astype(toks.dtype)
+            return (nxt, lens + 1, ck, cv), nxt
+
+        (_, _, ck, cv), gen = lax.scan(
+            step, (toks, lens, cache["k"], cache["v"]), None,
+            length=self.scfg.decode_steps_per_call)
+        return jax.tree.map(lambda t: t[None],
+                            (gen, {"k": ck, "v": cv}))
+
+    def _prefill_body(self, params, cache, toks, slot_id, true_len):
+        params, cache, toks, slot_id, true_len = jax.tree.map(
+            lambda t: t[0], (params, cache, toks, slot_id, true_len))
+        cfg, m = self.cfg, self.m
+        Hl = cfg.heads // m.tp
+        hsz = cfg.d_model // cfg.heads
+        Tpad = toks.shape[0]
+        positions = jnp.arange(Tpad)
+        x = params["shared"]["embed"][toks][None]             # [1, Tpad, D]
+
+        def stage_apply(blocks, x, ck, cv):
+            def one(x, xs):
+                lp, kl, vl = xs
+                h = _ln(x)
+                q, k, v = jnp.split(h @ lp["wqkv"], 3, axis=-1)
+                q = apply_rope(q.reshape(1, Tpad, Hl, hsz), positions)
+                k = apply_rope(k.reshape(1, Tpad, Hl, hsz), positions)
+                v = v.reshape(1, Tpad, Hl, hsz)
+                # the whole padded prompt lands in the slot; positions past
+                # true_len hold garbage that decode's length mask never
+                # reads before the append overwrites it
+                kl = lax.dynamic_update_slice(
+                    kl, k[0][None].astype(kl.dtype), (slot_id, 0, 0, 0))
+                vl = lax.dynamic_update_slice(
+                    vl, v[0][None].astype(vl.dtype), (slot_id, 0, 0, 0))
+                att = dense_attention(q, k, v, causal=True)
+                x = x + lax.psum(
+                    att.reshape(1, Tpad, Hl * hsz) @ lp["wo"], "tp")
+                h = _ln(x)
+                x = x + lax.psum(
+                    jax.nn.gelu(h @ lp["w1"]) @ lp["w2"], "tp")
+                return x, (kl, vl)
+            x, (nk, nv) = lax.scan(one, x, (blocks, ck, cv))
+            return x, nk, nv
+
+        x, ck, cv, sid = self._pp_cycle(params["blocks"], x,
+                                        cache["k"], cache["v"], stage_apply)
+        logits = jnp.where(sid == 0, _ln(x[0]) @ params["shared"]["head"],
+                           0.0)                               # [Tpad, V]
+        logits = lax.psum(logits, "stage")
+        last = lax.dynamic_slice_in_dim(logits, true_len - 1, 1, axis=0)[0]
+        nxt = jnp.argmax(last, axis=-1).astype(toks.dtype)
+        return jax.tree.map(lambda t: t[None],
+                            (nxt, last, {"k": ck, "v": cv}))
+
+    # ------------------------------------------------------------------
+    # host-side surface (per-REPLICA shapes; the engine broadcasts each
+    # replica's row across its slice devices)
+    # ------------------------------------------------------------------
+
+    def _expand(self, arr: np.ndarray) -> jax.Array:
+        """``[replicas, ...]`` host array -> ``[n_devices, ...]`` on mesh."""
+        arr = np.asarray(arr)
+        if arr.shape[0] != self.m.dp:
+            raise ValueError(f"leading axis {arr.shape[0]} != replica count "
+                             f"{self.m.dp}")
+        return jax.device_put(
+            jnp.asarray(np.repeat(arr, self.m.slice_size, axis=0)),
+            self._sharding)
+
+    def _collect(self, out: jax.Array) -> np.ndarray:
+        """``[n_devices, ...]`` -> ``[replicas, ...]`` (slice rows agree)."""
+        return np.asarray(out)[::self.m.slice_size]
+
+    def prefill(self, replica: int, slot: int,
+                tokens: Sequence[int]) -> Tuple[int, np.ndarray]:
+        """Prefill one request into ``slot`` of ``replica``; other replicas
+        run the same program against their trash slot.  Returns the first
+        greedy token and the last-position logits ``[vocab]``."""
+        scfg = self.scfg
+        if not 0 <= slot < scfg.slots:
+            raise ValueError(f"slot {slot} out of range [0, {scfg.slots})")
+        if not tokens:
+            raise ValueError("empty prompt")
+        Tpad = scfg.prefill_bucket_for(len(tokens))
+        R = self.m.dp
+        toks = np.zeros((R, Tpad), np.int32)
+        toks[replica, :len(tokens)] = np.asarray(tokens, np.int32)
+        slot_id = np.full((R,), self.cache_cfg.trash_slot, np.int32)
+        slot_id[replica] = slot
+        true_len = np.ones((R,), np.int32)
+        true_len[replica] = len(tokens)
+        nxt, logits, self.cache = self._prefill_jit(
+            self.params, self.cache, self._expand(toks),
+            self._expand(slot_id), self._expand(true_len))
+        self._check_retrace(f"prefill Tpad={Tpad}")
+        return (int(self._collect(nxt)[replica]),
+                self._collect(logits)[replica])
+
+    def decode(self, tokens: np.ndarray, slots: np.ndarray,
+               lens: np.ndarray) -> np.ndarray:
+        """One fused decode call for every replica at one batch bucket.
+
+        ``tokens``/``slots``/``lens``: ``[replicas, S]`` with ``S`` in
+        ``batch_buckets``; idle lanes use the trash slot with ``lens=0``.
+        ``lens[r, i]`` is the position the lane's pending token occupies
+        (prompt length + tokens already generated).  Returns the greedy
+        tokens ``[replicas, decode_steps_per_call, S]``.
+        """
+        S = np.asarray(tokens).shape[1]
+        if S not in self.scfg.batch_buckets:
+            raise ValueError(f"batch lane count {S} is not a declared "
+                             f"bucket {self.scfg.batch_buckets}")
+        gen, self.cache = self._decode_jit(
+            self.params, self.cache,
+            self._expand(np.asarray(tokens, np.int32)),
+            self._expand(np.asarray(slots, np.int32)),
+            self._expand(np.asarray(lens, np.int32)))
+        self._check_retrace(f"decode S={S}")
+        return self._collect(gen)
+
+    def idle_lane(self) -> Tuple[int, int, int]:
+        """(token, slot, len) triple a padding lane should carry."""
+        return 0, self.cache_cfg.trash_slot, 0
+
+    def update_params(self, params: Any) -> None:
+        """Swap in a fresh ``[n, ...]``-stacked tree (shapes must match —
+        a shape change would retrace, which the sentinel will report)."""
+        self.params = jax.tree.map(
+            lambda x: jax.device_put(jnp.asarray(x), self._sharding), params)
+
+    def warmup(self) -> None:
+        """Compile every declared bucket, then arm the retrace sentinel."""
+        for Tpad in self.scfg.prefill_buckets:
+            self.prefill(0, 0, [0] * Tpad)
+        tok, slot, ln = self.idle_lane()
+        for S in self.scfg.batch_buckets:
+            R = self.m.dp
+            self.decode(np.full((R, S), tok, np.int32),
+                        np.full((R, S), slot, np.int32),
+                        np.full((R, S), ln, np.int32))
+        self._warm_sizes = (self._decode_jit._cache_size(),
+                            self._prefill_jit._cache_size())
+        _flight.record("serve", name="warmup",
+                       batch_buckets=list(self.scfg.batch_buckets),
+                       prefill_buckets=list(self.scfg.prefill_buckets))
+        _metrics.mark_steady_state(True)
+
+    def _check_retrace(self, detail: str) -> None:
+        if self._warm_sizes is None:
+            return
+        sizes = (self._decode_jit._cache_size(),
+                 self._prefill_jit._cache_size())
+        if sizes > self._warm_sizes:
+            _metrics.note_retrace(detail=f"serve engine {detail}")
+            self._warm_sizes = sizes

@@ -1,0 +1,176 @@
+"""Slotted paged KV cache for the decentralized serving engine.
+
+Layout (per device, i.e. per (replica, stage, tp) coordinate of the
+compose carving)::
+
+    k, v: [layers, slots + 1, max_len, kv_heads, head_dim]
+
+* ``layers``   — the decoder blocks THIS pipeline stage owns;
+* ``slots``    — request slots: one resident sequence each, allocated at
+  admission and recycled at retirement (continuous batching never reshapes
+  the cache — shapes are static so the decode program never retraces);
+* slot ``slots`` (the last physical row) is the **trash slot**: padding
+  rows of a bucketed decode batch append their garbage kv there, so an
+  inactive lane can run the exact same program as a live one;
+* ``max_len``  — per-slot token capacity (prompt + generated);
+* ``kv_heads`` — the kv heads THIS tp rank holds: the cache is sharded
+  over ``("tp",)`` by splitting heads, and the layout is grouped-query
+  aware (``kv_heads`` may be ``num_heads // group`` compact heads, the
+  same ``num_kv_heads`` contract as
+  :class:`bluefog_tpu.models.transformer.RingTransformerBlock` — q heads
+  attend their ``h // group`` kv head).
+
+The pure functions here (:func:`append_rows`, :func:`attend_rows`) are the
+single-device math the engine's shard_map body calls per layer; they are
+also unit-tested directly (GQA grouping, slot-reuse equivalence after
+evict).  :class:`SlotAllocator` is the host-side free list with occupancy
+gauges (``bluefog_serve_kv_slots_in_use`` / ``bluefog_serve_kv_occupancy``).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..utils import metrics as _metrics
+
+__all__ = ["KVCacheConfig", "init_cache", "append_rows", "attend_rows",
+           "SlotAllocator"]
+
+
+@dataclasses.dataclass(frozen=True)
+class KVCacheConfig:
+    """Static shape of one device's cache (all sharding already applied)."""
+    layers: int            # decoder blocks on this pipeline stage
+    slots: int             # request slots (excluding the trash slot)
+    max_len: int           # tokens per slot
+    kv_heads: int          # kv heads on this tp rank (GQA-compact)
+    head_dim: int
+    dtype: Any = jnp.float32
+
+    def __post_init__(self):
+        for name in ("layers", "slots", "max_len", "kv_heads", "head_dim"):
+            v = getattr(self, name)
+            if not isinstance(v, int) or v < 1:
+                raise ValueError(f"KVCacheConfig.{name}={v!r} must be a "
+                                 "positive int")
+
+    @property
+    def trash_slot(self) -> int:
+        """Physical row index padding lanes write their garbage kv to."""
+        return self.slots
+
+    def bytes(self) -> int:
+        """Device bytes of one (k, v) pair at this config."""
+        per = (self.layers * (self.slots + 1) * self.max_len
+               * self.kv_heads * self.head_dim)
+        return 2 * per * jnp.dtype(self.dtype).itemsize
+
+
+def init_cache(cfg: KVCacheConfig) -> dict:
+    """Zeroed ``{"k", "v"}`` cache (one extra physical row: the trash slot)."""
+    shape = (cfg.layers, cfg.slots + 1, cfg.max_len, cfg.kv_heads,
+             cfg.head_dim)
+    return {"k": jnp.zeros(shape, cfg.dtype), "v": jnp.zeros(shape, cfg.dtype)}
+
+
+def append_rows(kl: jax.Array, vl: jax.Array, slots: jax.Array,
+                lengths: jax.Array, k_new: jax.Array, v_new: jax.Array):
+    """Scatter one new token's kv into per-request slots (decode append).
+
+    ``kl/vl``: one layer's cache ``[slots+1, max_len, kv_heads, head_dim]``;
+    ``slots``/``lengths``: ``[S]`` int32 (the new token lands at index
+    ``lengths[i]`` of ``slots[i]``); ``k_new/v_new``: ``[S, kv_heads,
+    head_dim]``.  Duplicate (trash-slot) indices are allowed — last write
+    wins, and nothing ever reads the trash row.
+    """
+    kl = kl.at[slots, lengths].set(k_new.astype(kl.dtype))
+    vl = vl.at[slots, lengths].set(v_new.astype(vl.dtype))
+    return kl, vl
+
+
+def attend_rows(q: jax.Array, kl: jax.Array, vl: jax.Array,
+                slots: jax.Array, lengths: jax.Array,
+                scale: Optional[float] = None) -> jax.Array:
+    """Masked decode attention of one new token per request over its slot.
+
+    ``q``: ``[S, heads, head_dim]`` (heads may be ``group * kv_heads`` —
+    grouped-query attention repeats each compact kv head over its group);
+    ``kl/vl``: one layer's cache (post-append); ``lengths``: the position
+    the new token was appended at, so keys ``0 .. lengths[i]`` inclusive
+    are valid.  Same numerics as the dense oracle: f32-floor scores, scale
+    folded into q, ``-inf`` masking.
+    """
+    S, H, Dh = q.shape
+    Hkv = kl.shape[-2]
+    if H % Hkv:
+        raise ValueError(f"{H} q heads not a multiple of {Hkv} kv heads")
+    if scale is None:
+        scale = Dh ** -0.5
+    ks = kl[slots]                              # [S, max_len, Hkv, Dh]
+    vs = vl[slots]
+    if Hkv != H:
+        ks = jnp.repeat(ks, H // Hkv, axis=2)
+        vs = jnp.repeat(vs, H // Hkv, axis=2)
+    ct = jnp.promote_types(q.dtype, jnp.float32)
+    s = jnp.einsum("shd,slhd->shl", q.astype(ct) * scale, ks.astype(ct))
+    valid = jnp.arange(kl.shape[1])[None, :] <= lengths[:, None]   # [S, L]
+    s = jnp.where(valid[:, None, :], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("shl,slhd->shd", p, vs.astype(ct)).astype(q.dtype)
+
+
+class SlotAllocator:
+    """Host-side free list over one replica's request slots.
+
+    Continuous batching allocates a slot at admission and frees it at
+    retirement (or eviction); the device-side cache rows are never zeroed —
+    a recycled slot is overwritten by the next prefill and masked by its
+    new length, which the slot-reuse test pins as bit-equivalent to a
+    fresh cache.
+    """
+
+    def __init__(self, slots: int, *, replica: int = 0):
+        if slots < 1:
+            raise ValueError(f"need >= 1 slot, got {slots}")
+        self.slots = int(slots)
+        self.replica = int(replica)
+        self._free = list(range(self.slots - 1, -1, -1))   # pop() -> slot 0 first
+        self._in_use: set = set()
+
+    def alloc(self) -> Optional[int]:
+        """Lowest free slot id, or None when the replica is full."""
+        if not self._free:
+            return None
+        slot = self._free.pop()
+        self._in_use.add(slot)
+        self._export()
+        return slot
+
+    def free(self, slot: int) -> None:
+        if slot not in self._in_use:
+            raise ValueError(f"slot {slot} is not allocated")
+        self._in_use.discard(slot)
+        self._free.append(slot)
+        self._free.sort(reverse=True)
+        self._export()
+
+    @property
+    def in_use(self) -> int:
+        return len(self._in_use)
+
+    @property
+    def occupancy(self) -> float:
+        return len(self._in_use) / self.slots
+
+    def _export(self) -> None:
+        _metrics.gauge(
+            "bluefog_serve_kv_slots_in_use",
+            "allocated KV-cache slots, by replica").set(
+                float(self.in_use), replica=str(self.replica))
+        _metrics.gauge(
+            "bluefog_serve_kv_occupancy",
+            "KV-cache slot occupancy fraction, by replica").set(
+                self.occupancy, replica=str(self.replica))

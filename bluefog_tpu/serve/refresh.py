@@ -1,0 +1,190 @@
+"""Live weight refresh: serving replicas as pull-only leaves of training.
+
+The train→serve boundary is bluefog's asymmetric-communication sweet spot
+(PAPER.md: L4 window ops — one-sided progress without a global barrier):
+the training fleet never waits on serving, and a serving replica fetches
+whenever its staleness budget says so.  Concretely the refresher extends
+the fleet's rank space to ``n_train + n_serve`` rows, compiles a **pull
+schedule** (:func:`bluefog_tpu.schedule.compile_from_weights`) whose only
+edges run from training rows to serving rows — each serve device at slice
+offset ``o`` averages the training replicas' rows at the same offset, so
+(stage, tp) shards line up — and executes it with
+:func:`bluefog_tpu.ops.windows.win_pull` (create → get → update) under one
+jitted shard_map over a combined 1-D mesh.  Training rows have self
+weight 1 and no in-edges: the pull is a structural no-op for them.
+
+Staleness is first-class: ``bluefog_serve_staleness_steps`` gauges
+``current train step − step last pulled``; :meth:`maybe_refresh` pulls
+whenever it reaches ``BLUEFOG_REFRESH_EVERY`` (or the ``every=``
+override).  When a serving replica dies mid-stream the schedule is
+rebuilt without its in-edges (``mark_dead_serve_replica``) so the healed
+topology keeps pulling for the survivors — the chaos drill in
+tests/test_serve.py pins this.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from ..ops.windows import win_pull
+from ..parallel.compose import Mesh3D
+from ..schedule import compile_from_weights
+from ..utils import flight as _flight
+from ..utils import metrics as _metrics
+from .engine import ServeEngine
+
+__all__ = ["WeightRefresher", "DEFAULT_REFRESH_EVERY"]
+
+DEFAULT_REFRESH_EVERY = 10
+
+
+def _staleness_gauge():
+    return _metrics.gauge(
+        "bluefog_serve_staleness_steps",
+        "train steps between the training frontier and the weights "
+        "currently serving")
+
+
+class WeightRefresher:
+    """Periodically pull training params into a :class:`ServeEngine`.
+
+    ``train_m`` is the *training* carving; its intra-slice layout
+    (pp, tp, sp) must match the serving carving so that row ``r *
+    slice_size + o`` of the training tree and row ``q * slice_size + o``
+    of the serving tree hold the same (stage, tp) shard.  The param trees
+    stay ``[n, ...]``-stacked throughout — the combined tree is simply
+    their concatenation along the rank row axis.
+    """
+
+    def __init__(self, engine: ServeEngine, train_m: Mesh3D, *,
+                 every: Optional[int] = None):
+        if (train_m.pp, train_m.tp, train_m.sp) != (
+                engine.m.pp, engine.m.tp, engine.m.sp):
+            raise ValueError(
+                f"training slice layout (pp={train_m.pp}, tp={train_m.tp}, "
+                f"sp={train_m.sp}) != serving layout (pp={engine.m.pp}, "
+                f"tp={engine.m.tp}, sp={engine.m.sp}); a pull copies "
+                "same-shard rows and cannot re-shard")
+        if every is None:
+            every = int(os.environ.get("BLUEFOG_REFRESH_EVERY",
+                                       DEFAULT_REFRESH_EVERY))
+        if every < 1:
+            raise ValueError(f"refresh period must be >= 1 (got {every})")
+        self.engine = engine
+        self.train_m = train_m
+        self.every = every
+        self.n_train = train_m.size
+        self.n_serve = engine.m.size
+        self._dead: set = set()
+        self._last_pulled_step: Optional[int] = None
+        self._train_step = 0
+        self.pulls = 0
+        devs = np.concatenate([train_m.mesh.devices.reshape(-1),
+                               engine.m.mesh.devices.reshape(-1)])
+        if len(set(d.id for d in devs)) != len(devs):
+            raise ValueError("training and serving carvings share devices; "
+                             "the combined pull mesh needs disjoint fleets")
+        self._mesh = Mesh(devs, ("rank",))
+        self._sharding = NamedSharding(self._mesh, P("rank"))
+        self._rebuild()
+        _staleness_gauge().set(0.0)
+
+    # ------------------------------------------------------------------
+
+    def _rebuild(self) -> None:
+        n = self.n_train + self.n_serve
+        slice_sz = self.train_m.slice_size
+        dp_train = self.train_m.dp
+        self_w = [1.0] * n
+        src: list = [dict() for _ in range(n)]
+        for j in range(self.n_serve):
+            if j // slice_sz in self._dead:
+                continue                       # dead replica: identity row
+            o = j % slice_sz
+            self_w[self.n_train + j] = 0.0
+            src[self.n_train + j] = {
+                r * slice_sz + o: 1.0 / dp_train for r in range(dp_train)}
+        sched = compile_from_weights(n, self_w, src)
+
+        def body(x):
+            return win_pull(x[0], sched)[None]
+
+        self._pull_jit = jax.jit(jax.shard_map(
+            body, mesh=self._mesh, in_specs=P("rank"), out_specs=P("rank")))
+        self._fresh_program = True
+
+    def mark_dead_serve_replica(self, replica: int) -> None:
+        """Heal the pull topology after a serving replica dies: its rows
+        keep their (stale) identity and no training row feeds them."""
+        if not 0 <= replica < self.engine.m.dp:
+            raise ValueError(f"serve replica {replica} out of range")
+        if replica in self._dead:
+            return
+        self._dead.add(replica)
+        self._rebuild()
+        _flight.record("serve", name="refresh_heal", replica=replica)
+
+    # ------------------------------------------------------------------
+
+    def note_train_step(self, step: int) -> None:
+        """Advance the training frontier (drives the staleness gauge)."""
+        self._train_step = int(step)
+        if self._last_pulled_step is not None:
+            _staleness_gauge().set(
+                float(self._train_step - self._last_pulled_step))
+
+    def staleness(self) -> Optional[float]:
+        g = _metrics.get_metric("bluefog_serve_staleness_steps")
+        return None if g is None else g.value()
+
+    def pull(self, train_params: Any, train_step: Optional[int] = None) -> None:
+        """Fetch the training params into the engine, mid-traffic.
+
+        ``train_params``: the ``[n_train, ...]``-stacked training tree (a
+        live ``dist_params`` or a host copy).  The first pull (and the
+        first after a heal) compiles the schedule's program — an intended
+        trace, bracketed out of the retrace sentinel exactly like
+        ``bootstrap_params`` does for joins.
+        """
+        if train_step is not None:
+            self._train_step = int(train_step)
+        was_steady = _metrics.in_steady_state()
+        if self._fresh_program and was_steady:
+            _metrics.mark_steady_state(False)
+
+        def leaf_pull(t, s):
+            t, s = np.asarray(t), np.asarray(s)
+            if (t.shape[0] != self.n_train or s.shape[0] != self.n_serve
+                    or not np.issubdtype(t.dtype, np.floating)):
+                return s
+            combined = jax.device_put(
+                jnp.asarray(np.concatenate([t, s], axis=0)), self._sharding)
+            pulled = self._pull_jit(combined)
+            return np.asarray(pulled)[self.n_train:]
+
+        new_serve = jax.tree.map(leaf_pull, train_params, self.engine.params)
+        self.engine.update_params(new_serve)
+        if self._fresh_program and was_steady:
+            _metrics.mark_steady_state(True)
+        self._fresh_program = False
+        self.pulls += 1
+        self._last_pulled_step = self._train_step
+        _staleness_gauge().set(0.0)
+        _flight.record("serve", name="refresh_pull", step=self._train_step,
+                       pulls=self.pulls, dead=sorted(self._dead))
+
+    def maybe_refresh(self, train_params: Any, train_step: int) -> bool:
+        """Pull iff the staleness budget (``every``) is spent; returns
+        whether a pull happened."""
+        self.note_train_step(train_step)
+        if (self._last_pulled_step is not None
+                and self._train_step - self._last_pulled_step < self.every):
+            return False
+        self.pull(train_params)
+        return True

@@ -1,0 +1,282 @@
+"""Continuous batching: admit/retire requests between decode steps.
+
+The scheduler owns everything dynamic so the engine can stay static: a
+FIFO admission queue, one :class:`~.kv_cache.SlotAllocator` per replica,
+and the per-request token state.  Each :meth:`Scheduler.step` does
+
+1. **admit** — pop queued requests into free slots (prefill, one request
+   per call, prompt padded to a declared bucket);
+2. **decode** — one fused engine call for ALL replicas at the smallest
+   declared batch bucket that fits the busiest replica, idle lanes padded
+   with the trash slot;
+3. **retire** — requests that hit ``max_new_tokens`` (or the KV-cache
+   length ceiling) free their slot and close their latency clocks.
+
+Because admission only changes *which slot ids* ride in the bucketed
+arrays — never a shape — steady-state traffic re-runs the warmed programs
+and the retrace sentinel stays 0.
+
+Request metrics ride the existing registry (JSONL/Prometheus exporters
+and ``tools/metrics_report.py`` pick them up with no schema changes):
+``bluefog_requests_total{status=...}``, ``bluefog_tokens_generated_total``,
+and the ``bluefog_serve_token_latency_seconds`` histogram (p50/p99 via
+``histogram().percentile``).  A ``serve`` flight-bundle block
+(:func:`bluefog_tpu.utils.flight.register_block`) carries the last
+request ids per replica so ``tools/postmortem.py`` can blame the replica
+that died mid-stream.
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import deque
+from typing import Deque, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..utils import flight as _flight
+from ..utils import metrics as _metrics
+from .engine import ServeEngine
+from .kv_cache import SlotAllocator
+
+__all__ = ["Request", "Scheduler"]
+
+LATENCY_BUCKETS = (.0005, .001, .0025, .005, .01, .025, .05, .1, .25, .5,
+                   1.0, 2.5)
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request and its full lifecycle state."""
+    id: int
+    prompt: List[int]
+    max_new_tokens: int
+    state: str = "queued"            # queued -> running -> done | failed
+    replica: int = -1
+    slot: int = -1
+    generated: List[int] = dataclasses.field(default_factory=list)
+    submitted_at: float = 0.0
+    first_token_at: Optional[float] = None
+    finished_at: Optional[float] = None
+
+    @property
+    def next_pos(self) -> int:
+        """KV position the pending (last generated) token will occupy."""
+        return len(self.prompt) + len(self.generated) - 1
+
+    @property
+    def ttft(self) -> Optional[float]:
+        if self.first_token_at is None:
+            return None
+        return self.first_token_at - self.submitted_at
+
+
+class Scheduler:
+    """Continuous batching over one :class:`ServeEngine`."""
+
+    def __init__(self, engine: ServeEngine):
+        self.engine = engine
+        self.replicas = engine.m.dp
+        self._queue: Deque[Request] = deque()
+        self._alloc = [SlotAllocator(engine.scfg.slots, replica=r)
+                       for r in range(self.replicas)]
+        self._active: List[Dict[int, Request]] = [
+            {} for _ in range(self.replicas)]
+        self._dead: set = set()
+        self._next_id = 0
+        self._last_ids: List[List[int]] = [[] for _ in range(self.replicas)]
+        self.completed: List[Request] = []
+        self.failed: List[Request] = []
+        _flight.register_block("serve", self._flight_block)
+
+    # ------------------------------------------------------------------
+
+    def submit(self, prompt: Sequence[int], max_new_tokens: int = 8,
+               now: Optional[float] = None) -> Request:
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        # reject unservable prompts at submit, not mid-stream
+        self.engine.scfg.prefill_bucket_for(len(prompt))
+        req = Request(id=self._next_id, prompt=list(prompt),
+                      max_new_tokens=max_new_tokens,
+                      submitted_at=time.monotonic() if now is None else now)
+        self._next_id += 1
+        self._queue.append(req)
+        return req
+
+    @property
+    def pending(self) -> int:
+        return len(self._queue)
+
+    @property
+    def in_flight(self) -> int:
+        return sum(len(a) for a in self._active)
+
+    @property
+    def done(self) -> bool:
+        return not self._queue and self.in_flight == 0
+
+    def live_replicas(self) -> List[int]:
+        return [r for r in range(self.replicas) if r not in self._dead]
+
+    # ------------------------------------------------------------------
+
+    def fail_replica(self, replica: int) -> List[Request]:
+        """Take a replica out of rotation (chaos kill / health eviction).
+
+        Its in-flight requests fail (their KV lived on the dead slice);
+        queued requests are untouched and will admit onto survivors.
+        """
+        if replica in self._dead:
+            return []
+        self._dead.add(replica)
+        lost = list(self._active[replica].values())
+        for req in lost:
+            req.state = "failed"
+            req.finished_at = time.monotonic()
+            self._alloc[replica].free(req.slot)
+            self.failed.append(req)
+            _metrics.counter(
+                "bluefog_requests_total",
+                "serve requests by terminal status").inc(status="failed")
+        self._active[replica].clear()
+        _flight.record("serve", name="replica_failed", replica=replica,
+                       lost_requests=[r.id for r in lost])
+        if not self.live_replicas():
+            raise RuntimeError("every serving replica has failed")
+        return lost
+
+    # ------------------------------------------------------------------
+
+    def step(self) -> List[Request]:
+        """One admit → decode → retire cycle; returns requests retired
+        this cycle."""
+        self._admit()
+        retired = self._decode_once()
+        return retired
+
+    def drain(self, max_steps: int = 10_000) -> None:
+        """Run until every submitted request reaches a terminal state."""
+        for _ in range(max_steps):
+            if self.done:
+                return
+            self.step()
+        raise RuntimeError(f"scheduler did not drain in {max_steps} steps")
+
+    # ------------------------------------------------------------------
+
+    def _admit(self) -> None:
+        # a lane needs a free KV slot AND a decode lane: never admit past
+        # the largest declared batch bucket — undeclared lane counts have
+        # no compiled program to run under
+        lane_cap = min(self.engine.scfg.slots,
+                       self.engine.scfg.batch_buckets[-1])
+        while self._queue:
+            target = None
+            for r in sorted(self.live_replicas(),
+                            key=lambda r: len(self._active[r])):
+                if (self._alloc[r].in_use < self.engine.scfg.slots
+                        and len(self._active[r]) < lane_cap):
+                    target = r
+                    break
+            if target is None:
+                return                       # every live replica is full
+            req = self._queue.popleft()
+            slot = self._alloc[target].alloc()
+            req.replica, req.slot, req.state = target, slot, "running"
+            t0 = time.monotonic()
+            first, _ = self.engine.prefill(target, slot, req.prompt)
+            req.first_token_at = time.monotonic()
+            req.generated.append(first)
+            _metrics.counter(
+                "bluefog_tokens_generated_total",
+                "tokens produced by serve decode steps").inc()
+            _metrics.histogram(
+                "bluefog_serve_token_latency_seconds",
+                "per-token serve latency (prefill + decode)",
+                buckets=LATENCY_BUCKETS).observe(req.first_token_at - t0)
+            self._active[target][slot] = req
+            self._last_ids[target] = (self._last_ids[target] + [req.id])[-8:]
+            self._maybe_retire(req)
+
+    def _decode_once(self) -> List[Request]:
+        lanes = [sorted(self._active[r]) for r in range(self.replicas)]
+        busiest = max((len(l) for l in lanes), default=0)
+        if busiest == 0:
+            return []
+        S = self.engine.scfg.batch_bucket_for(busiest)
+        idle_tok, idle_slot, idle_len = self.engine.idle_lane()
+        R = self.replicas
+        toks = np.full((R, S), idle_tok, np.int32)
+        slots = np.full((R, S), idle_slot, np.int32)
+        lens = np.full((R, S), idle_len, np.int32)
+        for r in range(R):
+            for i, slot in enumerate(lanes[r]):
+                req = self._active[r][slot]
+                toks[r, i] = req.generated[-1]
+                slots[r, i] = slot
+                lens[r, i] = req.next_pos
+        t0 = time.monotonic()
+        gen = self.engine.decode(toks, slots, lens)   # [R, steps, S]
+        dt = time.monotonic() - t0
+        steps = gen.shape[1]
+        n_tokens = 0
+        retired: List[Request] = []
+        for r in range(R):
+            for i, slot in enumerate(lanes[r]):
+                req = self._active[r][slot]
+                room = req.max_new_tokens - len(req.generated)
+                new = [int(t) for t in gen[r, :, i][:room]]
+                req.generated.extend(new)
+                n_tokens += len(new)
+                done = self._maybe_retire(req)
+                if done:
+                    retired.append(req)
+        if n_tokens:
+            _metrics.counter(
+                "bluefog_tokens_generated_total",
+                "tokens produced by serve decode steps").inc(n_tokens)
+            h = _metrics.histogram(
+                "bluefog_serve_token_latency_seconds",
+                "per-token serve latency (prefill + decode)",
+                buckets=LATENCY_BUCKETS)
+            for _ in range(min(steps, 64)):   # bounded observer cost
+                h.observe(dt / steps)
+        return retired
+
+    def _maybe_retire(self, req: Request) -> bool:
+        # the next fused call appends at next_pos .. next_pos + steps - 1,
+        # all of which must fit under the per-slot capacity
+        steps = self.engine.scfg.decode_steps_per_call
+        if (len(req.generated) < req.max_new_tokens
+                and req.next_pos + steps <= self.engine.scfg.max_len):
+            return False
+        req.state = "done"
+        req.finished_at = time.monotonic()
+        self._active[req.replica].pop(req.slot, None)
+        self._alloc[req.replica].free(req.slot)
+        self.completed.append(req)
+        _metrics.counter(
+            "bluefog_requests_total",
+            "serve requests by terminal status").inc(status="done")
+        return True
+
+    # ------------------------------------------------------------------
+
+    def _flight_block(self) -> dict:
+        """The ``serve`` bundle block postmortem reads after a chaos kill."""
+        return {
+            "replicas": self.replicas,
+            "dead_replicas": sorted(self._dead),
+            "pending": self.pending,
+            "in_flight": {str(r): sorted(req.id
+                                         for req in self._active[r].values())
+                          for r in range(self.replicas) if self._active[r]},
+            "last_request_ids": {str(r): ids for r, ids
+                                 in enumerate(self._last_ids) if ids},
+            "completed": len(self.completed),
+            "failed": [r.id for r in self.failed],
+        }
+
+    def close(self) -> None:
+        _flight.unregister_block("serve")

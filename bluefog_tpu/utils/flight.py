@@ -267,6 +267,12 @@ def dump(path: Optional[str] = None, reason: str = "manual") -> str:
             "open_spans": _open_spans_block(),
             "metrics": _metrics_block(),
         }
+        for name, fn in list(_block_providers.items()):
+            try:
+                bundle[name] = fn()
+            except Exception as e:                        # pragma: no cover
+                # a sick provider must never lose the bundle it narrates
+                bundle[name] = {"error": f"{type(e).__name__}: {e}"}
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         tmp = f"{path}.tmp.{os.getpid()}"
         with open(tmp, "w") as f:
@@ -274,6 +280,33 @@ def dump(path: Optional[str] = None, reason: str = "manual") -> str:
         os.replace(tmp, path)
     record("dump", name=reason, path=path)
     return path
+
+
+# ---------------------------------------------------------------------------
+# Extra bundle blocks
+# ---------------------------------------------------------------------------
+# Subsystems with state worth a postmortem but no business importing this
+# module's internals (the serve scheduler's in-flight request ids, for one)
+# register a provider; each dump calls it and embeds the returned dict as a
+# top-level bundle key of the same name.
+
+_block_providers: dict = {}
+
+_RESERVED_BLOCKS = frozenset({
+    "schema", "rank", "pid", "ts", "reason", "reasons", "capacity",
+    "n_events", "dropped", "events", "topology", "open_spans", "metrics"})
+
+
+def register_block(name: str, fn) -> None:
+    """Register ``fn() -> dict`` to contribute bundle key ``name``."""
+    if name in _RESERVED_BLOCKS:
+        raise ValueError(f"block name {name!r} collides with a core "
+                         "bundle key")
+    _block_providers[name] = fn
+
+
+def unregister_block(name: str) -> None:
+    _block_providers.pop(name, None)
 
 
 # ---------------------------------------------------------------------------
@@ -374,6 +407,7 @@ def reset() -> None:
     _last_seq = 0
     _op_calls.clear()
     _dump_reasons.clear()
+    _block_providers.clear()
     _dump_dir = None
     if _handlers_installed:
         if sys.excepthook is _excepthook:

@@ -35,6 +35,10 @@ MODULES = [
     ("bluefog_tpu.parallel.tensor_parallel", "Tensor parallelism"),
     ("bluefog_tpu.parallel.expert", "Expert (MoE) parallelism"),
     ("bluefog_tpu.checkpoint", "Checkpointing (orbax, elastic, async)"),
+    ("bluefog_tpu.serve.engine", "Serving engine (prefill + fused decode)"),
+    ("bluefog_tpu.serve.kv_cache", "Slotted paged KV cache"),
+    ("bluefog_tpu.serve.scheduler", "Continuous batching scheduler"),
+    ("bluefog_tpu.serve.refresh", "Live gossip weight refresh"),
     ("bluefog_tpu.data", "Sharded input pipeline"),
     ("bluefog_tpu.fusion", "Tensor fusion (per-dtype bucketing)"),
     ("bluefog_tpu.models", "Model zoo"),

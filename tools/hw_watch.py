@@ -212,6 +212,16 @@ def _battery_steps(tag: str, stage: int = 0) -> list:
                       [py, lm, "--pallas", "--out",
                        os.path.join(m, f"lm_bench_pallas_{tag}.json")],
                       2400, None, None))
+    sb = os.path.join(REPO, "tools", "serve_bench.py")
+    if os.path.exists(sb):
+        # the serving grader on the same 8 chips: 2 training replicas
+        # feeding 2 serving replicas (pp=2 each) — tokens/s, per-token
+        # p50/p99, decode MFU vs the roofline, refresh staleness
+        steps.append(("serve_bench",
+                      [py, sb, "--train-dp", "2", "--serve-dp", "2",
+                       "--pp", "2", "--out",
+                       os.path.join(m, f"serve_bench_{tag}.json")],
+                      2400, None, None))
     # 1,5,10 not 1,2,5,10: one fewer ResNet compile (~5 min of window)
     # and k=2 adds nothing the amortization curve needs
     steps.append(("step_sweep",
@@ -277,6 +287,11 @@ def _rehearsal_steps(tag: str) -> list:
          [py, os.path.join(REPO, "tools", "lm_bench.py"),
           "--virtual-cpu", "--smoke", "--pallas",
           "--out", os.path.join(m, f"lm_bench_pallas_{tag}.json")], 900,
+         None, None),
+        ("serve_bench",
+         [py, os.path.join(REPO, "tools", "serve_bench.py"),
+          "--virtual-cpu", "--smoke",
+          "--out", os.path.join(m, f"serve_bench_{tag}.json")], 900,
          None, None),
         ("step_sweep",
          [py, os.path.join(REPO, "tools", "step_sweep.py"),

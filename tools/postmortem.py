@@ -20,6 +20,11 @@ job look like on the way down*:
                    failure, merged across ranks by step
     topology       the gossip edges active at dump time (post-healing),
                    from the bundles' topology blocks
+    serve          (serving fleets only) merged scheduler state: dead
+                   replicas, last-request ids per bundle, the request ids
+                   lost with a killed replica — chaos kills name their
+                   victim rank in the event, and the verdict blames that
+                   rank even when another process recorded the kill
 
 Torn bundles (a rank killed mid-write) are skipped with a warning, never
 fatal — same contract as ``tools/metrics_report.py`` with truncated JSONL.
@@ -34,6 +39,7 @@ Output schema (stable, pinned by tests/test_flight.py and
      "failure_kind", "detail"}, "per_rank": {rank: {...}},
      "step_time": {"mean_s", "skew_s", "straggler_rank"},
      "consensus": [[step, max_distance], ...], "topology": {...},
+     "serve": {...} (only when a bundle carries a serve block),
      "notes": [str, ...]}
 """
 import argparse
@@ -65,7 +71,9 @@ def load_bundle(path, notes):
 
 
 def _failure_candidates(rank, bundle):
-    """(priority, step, ts, kind, detail) tuples — lower sorts earlier."""
+    """(priority, step, ts, kind, detail, event_rank) tuples — lower sorts
+    earlier; ``event_rank`` is the rank the event itself names (chaos kills
+    only), which outranks the bundle's own rank for blame."""
     out = []
     for ev in bundle.get("events", ()):
         kind = ev.get("kind")
@@ -73,19 +81,24 @@ def _failure_candidates(rank, bundle):
             name = ev.get("name", "failure")
             prio = 0 if name in _HARD_KINDS else 1
             out.append((prio, ev.get("step"), ev.get("ts"),
-                        name, ev.get("detail", "")))
+                        name, ev.get("detail", ""), None))
         elif kind == "chaos" and str(ev.get("name", "")).startswith("kill"):
+            # the fault grammar records WHICH rank the kill targeted; carry
+            # it so the verdict can blame that rank even when the event was
+            # observed from another rank's bundle (single-process sims,
+            # serve fleets where the scheduler outlives the dead replica)
             out.append((0, ev.get("step"), ev.get("ts"), "kill",
-                        f"chaos kill (rank {ev.get('rank')})"))
+                        f"chaos kill (rank {ev.get('rank')})",
+                        ev.get("rank")))
     # a dump whose reason is a hard failure counts even if the failure
     # event itself was evicted from the ring
     for reason in bundle.get("reasons", ()):
         if reason in _HARD_KINDS and not any(r[0] == 0 for r in out):
             out.append((0, None, bundle.get("ts"), reason,
-                        f"dump reason {reason!r}"))
+                        f"dump reason {reason!r}", None))
         elif reason in _SOFT_KINDS:
             out.append((1, None, bundle.get("ts"), reason,
-                        f"dump reason {reason!r}"))
+                        f"dump reason {reason!r}", None))
     return out
 
 
@@ -199,6 +212,33 @@ def _step_time_block(bundles, per_rank):
     }
 
 
+def _serve_block(bundles, notes):
+    """Merge the bundles' ``serve`` blocks (scheduler state at dump time):
+    per-bundle last-request ids, dead replicas, in-flight work.  Present
+    only when at least one bundle came from a serving process."""
+    merged = {}
+    for rank in sorted(bundles):
+        sv = bundles[rank].get("serve")
+        if not isinstance(sv, dict):
+            continue
+        if "error" in sv:
+            notes.append(f"rank {rank}: serve block provider failed: "
+                         f"{sv['error']}")
+            continue
+        merged[str(rank)] = sv
+    if not merged:
+        return None
+    dead = sorted({d for sv in merged.values()
+                   for d in sv.get("dead_replicas", ())})
+    lost = sorted({r for sv in merged.values()
+                   for r in sv.get("failed", ())})
+    return {
+        "per_bundle": merged,
+        "dead_replicas": dead,
+        "failed_request_ids": lost,
+    }
+
+
 def analyze(bundles, notes=None, torn=()):
     """``{rank: bundle}`` -> postmortem report dict."""
     notes = notes if notes is not None else []
@@ -212,8 +252,12 @@ def analyze(bundles, notes=None, torn=()):
     # -- verdict ----------------------------------------------------------
     candidates = []        # (priority, step, ts, rank, kind, detail)
     for rank, bundle in bundles.items():
-        for prio, step, ts, kind, detail in _failure_candidates(rank, bundle):
-            candidates.append((prio, step, ts, rank, kind, detail))
+        for prio, step, ts, kind, detail, ev_rank in _failure_candidates(
+                rank, bundle):
+            # a chaos kill names its victim in the event; that beats the
+            # rank of whichever bundle happened to record it
+            blame = ev_rank if ev_rank is not None else rank
+            candidates.append((prio, step, ts, blame, kind, detail))
     verdict = {"first_failed_rank": None, "failure_step": None,
                "failure_kind": None, "detail": None}
     hard = [c for c in candidates if c[0] == 0]
@@ -225,7 +269,7 @@ def analyze(bundles, notes=None, torn=()):
             c[2] if c[2] is not None else float("inf")))
         prio, step, ts, rank, kind, detail = pool[0]
         if step is None:
-            step = per_rank[rank]["last_step"]
+            step = per_rank[rank]["last_step"] if rank in per_rank else None
         verdict = {"first_failed_rank": rank, "failure_step": step,
                    "failure_kind": kind, "detail": detail}
         if not hard:
@@ -257,6 +301,9 @@ def analyze(bundles, notes=None, torn=()):
         "consensus": _consensus_trajectory(bundles),
         "topology": _topology_block(bundles, notes),
     }
+    serve = _serve_block(bundles, notes)
+    if serve is not None:
+        report["serve"] = serve
     if notes:
         report["notes"] = notes
     return report

@@ -1,0 +1,294 @@
+"""End-to-end grader for the decentralized serving engine.
+
+Brings up the full train→serve estate on one device set: a gossip-DP
+training fleet (``compose.make_train_step``) on the first ``train_dp``
+slices and a :class:`bluefog_tpu.serve.ServeEngine` +
+:class:`~bluefog_tpu.serve.Scheduler` on the rest, with a
+:class:`~bluefog_tpu.serve.WeightRefresher` pulling fresh params
+mid-traffic.  Grades serving on every axis ISSUE 10's claim rides on:
+
+* **tokens/sec** of the continuous-batching drain (prefill + decode,
+  training interleaved on the same host);
+* **p50 / p99 per-token latency** from the
+  ``bluefog_serve_token_latency_seconds`` histogram, plus TTFT
+  percentiles from the completed requests themselves;
+* **decode MFU** against the trusted roofline ceiling
+  (``bench._peak_flops``; null off-TPU) using forward-only decode
+  FLOPs/token (2N weight term + exact per-request attention context);
+* **refresh staleness**: max and final value of the
+  ``bluefog_serve_staleness_steps`` gauge, and the pull count — the
+  freshness the gossip leaf actually delivered under load;
+* **invariants**: KV-cache donation intact after the drain, retrace
+  sentinel 0 after warmup (every served shape hit a declared bucket).
+
+Emits a ``bluefog-serve-bench-1`` JSON artifact (last stdout line, and
+``--out``).
+
+Run:    python tools/serve_bench.py --train-dp 2 --serve-dp 2 --pp 2 --out ...
+Smoke:  python tools/serve_bench.py --virtual-cpu --smoke
+"""
+import argparse
+import importlib.util
+import json
+import os
+import sys
+import time
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, REPO)
+
+SCHEMA = "bluefog-serve-bench-1"
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name + "_mod", os.path.join(REPO, name + ".py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--virtual-cpu", action="store_true",
+                    help="virtual CPU mesh sized (train_dp+serve_dp)*pp*tp "
+                         "(smoke/tests)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny shapes for CI (implies quick compile)")
+    ap.add_argument("--train-dp", type=int, default=2,
+                    help="training gossip-DP replicas")
+    ap.add_argument("--serve-dp", type=int, default=2,
+                    help="serving replicas (engine gossip-DP axis)")
+    ap.add_argument("--pp", type=int, default=1, help="pipeline stages")
+    ap.add_argument("--tp", type=int, default=1, help="tensor-parallel ways")
+    ap.add_argument("--layers", type=int, default=None)
+    ap.add_argument("--d-model", type=int, default=None)
+    ap.add_argument("--heads", type=int, default=None)
+    ap.add_argument("--vocab", type=int, default=None)
+    ap.add_argument("--requests", type=int, default=None,
+                    help="concurrent requests to drain (default 16)")
+    ap.add_argument("--max-new", type=int, default=None,
+                    help="tokens generated per request (default 8)")
+    ap.add_argument("--buckets", default=None,
+                    help="'<batch,..>@<prompt_len,..>' serve shape buckets "
+                         "(default from BLUEFOG_SERVE_BUCKETS or 1,2,4@8,16)")
+    ap.add_argument("--slots", type=int, default=None,
+                    help="KV slots per replica (default 8)")
+    ap.add_argument("--max-len", type=int, default=None,
+                    help="KV rows per slot (default 64)")
+    ap.add_argument("--decode-steps-per-call", type=int, default=None,
+                    help="fused decode steps per engine call (default 2)")
+    ap.add_argument("--train-steps", type=int, default=None,
+                    help="train steps interleaved with serving (default 6)")
+    ap.add_argument("--refresh-every", type=int, default=None,
+                    help="pull fresh weights every N train steps "
+                         "(default from BLUEFOG_REFRESH_EVERY or 2)")
+    ap.add_argument("--out", default=None, help="json artifact path")
+    ap.add_argument("--allow-cpu", action="store_true")
+    args = ap.parse_args()
+
+    n_chips = (args.train_dp + args.serve_dp) * args.pp * args.tp
+    if args.virtual_cpu:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count="
+                f"{n_chips}").strip()
+    import jax
+    if args.virtual_cpu:
+        jax.config.update("jax_platforms", "cpu")
+
+    from bluefog_tpu.utils.config import enable_compilation_cache
+    enable_compilation_cache()
+
+    dev = jax.devices()[0]
+    on_tpu = jax.default_backend() == "tpu"
+    if dev.platform == "cpu" and not (args.virtual_cpu or args.allow_cpu):
+        print("refusing: no accelerator (pass --virtual-cpu or --allow-cpu)",
+              file=sys.stderr)
+        sys.exit(2)
+    if len(jax.devices()) < n_chips:
+        raise SystemExit(
+            f"need {n_chips} devices for (train_dp+serve_dp)*pp*tp, "
+            f"have {len(jax.devices())}")
+
+    smoke = args.smoke or (args.virtual_cpu and not on_tpu)
+    layers = args.layers or (args.pp * (2 if smoke else 2))
+    d_model = args.d_model or (32 if smoke else 1024)
+    heads = args.heads or (4 if smoke else 16)
+    vocab = args.vocab or (64 if smoke else 32768)
+    n_requests = args.requests or 16
+    max_new = args.max_new or 8
+    slots = args.slots or 8
+    max_len = args.max_len or 64
+    steps_per_call = args.decode_steps_per_call or 2
+    train_steps = args.train_steps if args.train_steps is not None else 6
+    refresh_every = args.refresh_every
+    if refresh_every is None and smoke and "BLUEFOG_REFRESH_EVERY" not in \
+            os.environ:
+        refresh_every = 2
+
+    import numpy as np
+    import optax
+    import bluefog_tpu.optimizers as bfopt
+    from bluefog_tpu.parallel import compose
+    from bluefog_tpu.serve import (ServeConfig, ServeEngine, Scheduler,
+                                   WeightRefresher)
+    from bluefog_tpu.serve.engine import _parse_buckets
+    from bluefog_tpu.utils import metrics as bfm
+
+    devs = jax.devices()
+    slice_sz = args.pp * args.tp
+    train_devs = devs[:args.train_dp * slice_sz]
+    serve_devs = devs[args.train_dp * slice_sz:n_chips]
+
+    cfg = compose.LMConfig(
+        vocab=vocab, d_model=d_model, heads=heads, layers=layers,
+        seq_len=32 if smoke else 128, micro=max(2 * args.pp, 2),
+        batch=2)
+    train_m = compose.compose_parallelism(
+        args.train_dp, args.pp, args.tp, 1, devices=train_devs)
+    serve_m = compose.compose_parallelism(
+        args.serve_dp, args.pp, args.tp, 1, devices=serve_devs)
+    cfg.validate(train_m)
+
+    sc_kw = dict(slots=slots, max_len=max_len,
+                 decode_steps_per_call=steps_per_call)
+    if args.buckets:
+        bb, pb = _parse_buckets(args.buckets)
+        scfg = ServeConfig(batch_buckets=bb, prefill_buckets=pb, **sc_kw)
+    else:
+        scfg = ServeConfig.from_env(**sc_kw)
+
+    # -- training fleet -----------------------------------------------------
+    grad_fn = compose.make_lm_grad_fn(cfg, train_m)
+    step, strategy = compose.make_train_step(
+        train_m, grad_fn, optax.adam(5e-3))
+    train_params = compose.init_lm_params(cfg, train_m, seed=1)
+    state = bfopt.init_distributed(strategy, train_params)
+    toks = compose.make_lm_batch(cfg, train_m)
+    train_params = compose.device_put(train_m, train_params)
+
+    # -- serving fleet ------------------------------------------------------
+    serve_params = compose.init_lm_params(cfg, serve_m, seed=0)
+    engine = ServeEngine(serve_m, cfg, serve_params, scfg)
+    engine.warmup()
+    refresher = WeightRefresher(engine, train_m, every=refresh_every)
+    sched = Scheduler(engine)
+    cache_probe = engine.cache["k"]       # donated into the first decode
+
+    rng = np.random.default_rng(0)
+    prompt_lens = []
+    for _ in range(n_requests):
+        n = int(rng.integers(2, scfg.prefill_buckets[-1] + 1))
+        prompt_lens.append(n)
+        sched.submit(rng.integers(0, vocab, n).tolist(),
+                     max_new_tokens=max_new)
+
+    # -- interleaved drain: serve steps with training advancing live --------
+    stal_max, pulls, train_done = 0.0, 0, 0
+    t0 = time.perf_counter()
+    guard = 0
+    while not sched.done:
+        guard += 1
+        if guard > 100_000:
+            raise RuntimeError("scheduler failed to drain")
+        sched.step()
+        if train_done < train_steps:
+            train_params, state, _ = step(train_params, state, toks)
+            train_done += 1
+            refresher.note_train_step(train_done)
+            stal_max = max(stal_max, refresher.staleness() or 0.0)
+            if refresher.maybe_refresh(train_params, train_done):
+                pulls += 1
+    dt = time.perf_counter() - t0
+    stal_final = refresher.staleness()
+
+    tokens = int(bfm.counter("bluefog_tokens_generated_total").total())
+    tok_per_sec = tokens / dt if dt > 0 else None
+
+    lat = bfm.get_metric("bluefog_serve_token_latency_seconds")
+    ttfts = sorted(r.ttft for r in sched.completed if r.ttft is not None)
+
+    # decode FLOPs/token: forward weight term + the exact attention
+    # context each generated token attended over (score + value matmuls)
+    n_tok, ctx_sum = 0, 0
+    for req in sched.completed:
+        p = len(req.prompt)
+        for i in range(len(req.generated)):
+            n_tok += 1
+            ctx_sum += p + i
+    avg_ctx = (ctx_sum / n_tok) if n_tok else 0.0
+    decode_flops_per_token = (2.0 * cfg.n_params
+                              + 4.0 * cfg.layers * cfg.d_model * avg_ctx)
+    bench = _load_tool("bench")
+    peak = bench._peak_flops(dev.device_kind) if on_tpu else None
+    serve_chips = args.serve_dp * slice_sz
+
+    retraces = int(bfm.counter("bluefog_retrace_after_warmup_total").total())
+    doc = {
+        "schema": SCHEMA,
+        "ok": True,
+        "on_accelerator": on_tpu,
+        "device": dev.device_kind,
+        "serve": {"replicas": args.serve_dp, "pp": args.pp, "tp": args.tp,
+                  "slots": slots, "max_len": max_len,
+                  "decode_steps_per_call": steps_per_call,
+                  "batch_buckets": list(scfg.batch_buckets),
+                  "prefill_buckets": list(scfg.prefill_buckets),
+                  "kv_cache_bytes": engine.cache_cfg.bytes()},
+        "train": {"replicas": args.train_dp, "steps": train_done},
+        "config": {"d_model": d_model, "heads": heads, "layers": layers,
+                   "vocab": vocab, "n_params": cfg.n_params},
+        "requests": {"submitted": n_requests,
+                     "completed": len(sched.completed),
+                     "failed": len(sched.failed),
+                     "max_new_tokens": max_new,
+                     "tokens_generated": tokens,
+                     "avg_prompt_len": round(float(np.mean(prompt_lens)), 2)},
+        "wall_s": round(dt, 4),
+        "tokens_per_sec": round(tok_per_sec, 1) if tok_per_sec else None,
+        "latency": {
+            "per_token_p50_s": (round(lat.percentile(0.5), 6)
+                                if lat is not None else None),
+            "per_token_p99_s": (round(lat.percentile(0.99), 6)
+                                if lat is not None else None),
+            "ttft_p50_s": (round(ttfts[len(ttfts) // 2], 6)
+                           if ttfts else None),
+            "ttft_max_s": round(ttfts[-1], 6) if ttfts else None,
+        },
+        "mfu": {"decode_flops_per_token": round(decode_flops_per_token, 1),
+                "avg_context": round(avg_ctx, 1),
+                "model_flops_per_sec": (
+                    round(tok_per_sec * decode_flops_per_token, 1)
+                    if tok_per_sec else None),
+                "peak_flops_per_chip": peak,
+                "mfu": (round(tok_per_sec * decode_flops_per_token
+                              / (peak * serve_chips), 6)
+                        if peak and tok_per_sec else None)},
+        "refresh": {"every": refresher.every, "pulls": pulls,
+                    "staleness_max_steps": stal_max,
+                    "staleness_final_steps": stal_final},
+        "invariants": {
+            "donation_intact": bool(cache_probe.is_deleted()),
+            "retraces_after_warmup": retraces,
+        },
+    }
+    doc["ok"] = bool(len(sched.completed) == n_requests
+                     and doc["invariants"]["donation_intact"]
+                     and retraces == 0
+                     and (train_steps == 0 or pulls >= 1))
+    sched.close()
+    _emit(doc, args.out)
+
+
+def _emit(doc, out):
+    if out:
+        os.makedirs(os.path.dirname(out) or ".", exist_ok=True)
+        with open(out, "w") as f:
+            json.dump(doc, f, indent=1)
+    print(json.dumps(doc))
+
+
+if __name__ == "__main__":
+    main()

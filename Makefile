@@ -7,7 +7,7 @@ PY ?= python
 .PHONY: test test-fast test_basic test_ops test_win_ops test_optimizer \
 	test_hier test_native test_examples verify native clean hw-watch \
 	obs-smoke chaos-smoke overlap-smoke postmortem-smoke pod-smoke \
-	autotune-smoke elastic-smoke lm-smoke serve-smoke
+	autotune-smoke elastic-smoke lm-smoke serve-smoke async-smoke
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -256,3 +256,22 @@ pod-smoke:
 		{'ici', 'dcn'}, d; \
 		assert all(s['frontier_ratio'] > 1 for s in d['shapes']), d; \
 		print('pod-smoke OK')"
+
+# async-gossip smoke: the bounded-staleness battery (mixing property,
+# float64 K=0 oracle, autotune plannability) plus the async frontier
+# artifact — one rank throttled 10x, async wall-clock-to-consensus must
+# strictly beat sync; schema drift in the frontier JSON fails here
+async-smoke:
+	$(PY) -m pytest tests/test_async_gossip.py -q -m "not slow"
+	$(PY) tools/gossip_bench.py --async-frontier --virtual-cpu \
+		--params 2048 --out /tmp/async_frontier.json
+	$(PY) -c "import json; \
+		d = json.load(open('/tmp/async_frontier.json')); \
+		assert d['schema'] == 'bluefog-gossip-async-1', d; \
+		assert d['throttle']['factor'] == 10, d; \
+		assert d['sync']['reached_target'] and \
+		d['async']['reached_target'], d; \
+		assert all(k in d['async'] for k in ('ticks', 'wall_s', \
+		'forced_syncs', 'staleness_max')), d; \
+		assert d['won'] is True and d['speedup'] > 1.0, d; \
+		print('async-smoke OK')"

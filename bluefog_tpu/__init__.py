@@ -41,9 +41,11 @@ from .parallel import (
     machine_rank, local_rank, suspend, resume,
     set_dynamic_topology, clear_dynamic_topology, dynamic_schedules,
     set_round_parallel, round_parallel, set_dcn_wire, dcn_wire,
+    set_async_gossip, async_gossip_bound,
     apply_plan,
     win_create, win_free, win_put, win_accumulate, win_get,
     win_update, win_update_then_collect, win_mutex, get_win_version,
+    get_win_stamps, win_staleness,
     win_associated_p,
     turn_on_win_ops_with_associated_p, turn_off_win_ops_with_associated_p,
 )

@@ -130,6 +130,10 @@ def _weights_for(name: str) -> Tuple[Optional[str], ...]:
         return (None,)
     if name == "push_sum":
         return ("push", "dst")
+    if name == "async_window_gossip":
+        # same contract family as push_sum: column-stochastic push weights
+        # required, dst-weighting enumerated to surface the audited rejection
+        return ("push", "dst")
     if name == "choco":
         return ("recv", "dst")
     return spec.weights

@@ -47,7 +47,8 @@ from .utils import metrics as _metrics
 __all__ = ["diagnose_consensus", "consensus_distance", "window_staleness",
            "check_finite", "record_peer_failure", "observe_peer_finiteness",
            "peer_health", "unhealthy_ranks", "reset_peer_health",
-           "observe_step_time", "last_step_times", "detect_stragglers"]
+           "observe_step_time", "last_step_times", "detect_stragglers",
+           "observe_async_staleness"]
 
 
 def _float_mask(tree) -> tuple:
@@ -261,6 +262,50 @@ def diagnose_consensus(params: Any, *,
             ev["skew_s"] = out["step_time_skew_s"]
             ev["stragglers"] = list(out["straggler_ranks"])
         _flight.record("consensus", **ev)
+    return out
+
+
+def observe_async_staleness(state: Any,
+                            record: bool = True) -> Optional[Dict[str, Any]]:
+    """Staleness-depth sample from an async-gossip training state.
+
+    ``state`` is a (distributed) ``DecentralizedState`` as returned by the
+    train step; when its ``comm_state`` is an
+    :class:`bluefog_tpu.optimizers.AsyncGossipState` this reads the carried
+    per-rank staleness depth — how many ticks stale the *oldest* neighbor
+    contribution was at the last tick — plus the per-rank local step
+    counters and the pending forced-sync flag.  Pure output reads: no
+    collective, no compile, composes with donation (the depth already rode
+    the step's carry).  Publishes the ``bluefog_async_staleness_steps`` /
+    ``bluefog_async_forced_sync`` gauges (the training-side sibling of the
+    serve fleet's ``bluefog_serve_staleness_steps`` family).  Returns the
+    sample dict, or None when ``state`` is not an async-gossip state.
+    """
+    from .optimizers import AsyncGossipState
+    cs = getattr(state, "comm_state", None)
+    if not isinstance(cs, AsyncGossipState):
+        return None
+    depth = np.asarray(cs.depth).reshape(-1)
+    local = np.asarray(cs.local_steps).reshape(-1)
+    forced = bool(np.asarray(cs.force).reshape(-1).any())
+    out = {
+        "staleness_depth": depth,
+        "staleness_depth_max": int(depth.max()) if depth.size else 0,
+        "local_steps": local,
+        "forced_sync_pending": forced,
+    }
+    if record:
+        _metrics.gauge(
+            "bluefog_async_staleness_steps",
+            "max over ranks of async-gossip staleness depth (ticks)"
+            ).set(out["staleness_depth_max"])
+        _metrics.gauge(
+            "bluefog_async_forced_sync",
+            "1 when the staleness bound forces a fleet sync-up next tick"
+            ).set(1.0 if forced else 0.0)
+        _flight.record(
+            "async_staleness", max=out["staleness_depth_max"],
+            forced=forced, local_steps=[int(x) for x in local])
     return out
 
 

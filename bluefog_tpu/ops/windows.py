@@ -24,6 +24,7 @@ sorted in-neighbor.  All ops are pure: they return the new window.
 """
 from __future__ import annotations
 
+from functools import lru_cache
 from typing import NamedTuple, Optional, Tuple
 
 import jax
@@ -145,11 +146,36 @@ def win_pull(x: jax.Array, sched: CommSchedule, *, axis: Axis = "rank",
     create + get + update collapse into one call.  Ranks with no in-edges
     and self weight 1 pass their tensor through untouched — the training
     side of a train→serve pull schedule is a no-op by construction.
+
+    No mailbox allocation happens here: the ``win_get`` overwrites every
+    slot the combine reads (a real slot receives exactly one delivery per
+    pull; slots beyond ``in_degree`` carry weight 0 in ``win_update``), so
+    zero-filling a ``[K, ...]`` recv block per refresh would be a dead
+    store.  The recv seed is a broadcast *view* of ``x`` that XLA never
+    materializes on its own.
     """
-    win = win_create(x, sched)
+    slots = max(sched.max_in_degree, 1)
+    win = Window(value=x, recv=jnp.broadcast_to(x, (slots,) + x.shape))
     win = win_get(win, sched, axis=axis, wire=wire)
     out, _ = win_update(win, sched, axis=axis)
     return out
+
+
+@lru_cache(maxsize=None)
+def _collect_masks(sched: CommSchedule) -> Tuple[np.ndarray, np.ndarray]:
+    """Unit self/slot weight tables for the collect combine, cached per
+    schedule so the fused-scan carry path sees the *same* array objects on
+    every trace (fresh numpy arrays are fresh trace constants, and constant
+    identity is part of the jit cache key for donated-carry scans)."""
+    n = sched.size
+    ones_self = np.ones(n, dtype=np.float32)
+    K = max(sched.max_in_degree, 1)
+    # slot k participates iff k < in_degree (a zero mailbox adds nothing, but
+    # keep the mask exact for clarity)
+    slot_ones = (np.arange(K)[:, None] < sched.in_degree[None, :]).astype(np.float32)
+    ones_self.setflags(write=False)
+    slot_ones.setflags(write=False)
+    return ones_self, slot_ones
 
 
 def win_update_then_collect(
@@ -157,12 +183,110 @@ def win_update_then_collect(
 ) -> Tuple[jax.Array, Window]:
     """Sum own tensor + all mailboxes, then clear them (reference:
     ``mpi_ops.py:1064-1080``) — the push-sum collection step."""
-    n = sched.size
-    ones_self = np.ones(n, dtype=np.float32)
-    K = max(sched.max_in_degree, 1)
-    # slot k participates iff k < in_degree (a zero mailbox adds nothing, but
-    # keep the mask exact for clarity)
-    slot_ones = (np.arange(K)[:, None] < sched.in_degree[None, :]).astype(np.float32)
+    ones_self, slot_ones = _collect_masks(sched)
     return win_update(
         win, sched, axis=axis,
         self_weight=ones_self, slot_weights=slot_ones, reset=True)
+
+
+# ---------------------------------------------------------------------------
+# Staleness stamps — the bookkeeping half of asynchronous window gossip.
+#
+# Each mailbox slot carries an int32 *step stamp*: the sender's local tick at
+# the moment of its most recent delivery.  ``tick - stamp`` is then the
+# staleness of the freshest contribution sitting in that slot, and the
+# maximum over real slots is the rank's staleness depth — the quantity the
+# bounded-staleness gate compares against K (reference: the passive-recv
+# thread's per-window version counters, ``mpi_controller.cc:795-860``).
+# ---------------------------------------------------------------------------
+
+
+def stamp_create(sched: CommSchedule) -> jax.Array:
+    """Fresh per-slot step stamps (everything delivered "now", tick 0)."""
+    slots = max(sched.max_in_degree, 1)
+    return jnp.zeros((slots,), jnp.int32)
+
+
+def stamp_push(stamps: jax.Array, tick: jax.Array, active: jax.Array,
+               sched: CommSchedule, *, axis: Axis = "rank") -> jax.Array:
+    """Deliver ``tick`` into out-neighbors' slot stamps where ``active``.
+
+    Mirrors :func:`_deliver` on the int32 stamp lane: an inactive sender
+    ships ``-1`` so the receiver-side ``max`` keeps the previous stamp (a
+    skipped tick must not look like a fresh delivery).
+    """
+    idx = lax.axis_index(axis)
+    tick = jnp.asarray(tick, jnp.int32)
+    send = jnp.where(active, tick, jnp.int32(-1))
+    for r in range(sched.num_rounds):
+        incoming = lax.ppermute(send, axis, sched.rounds[r])
+        received = jnp.asarray(sched.recv_src[r] >= 0)[idx]
+        slot = jnp.asarray(sched.recv_slot[r])[idx]
+        update = jnp.where(received, incoming, jnp.int32(-1))
+        stamps = stamps.at[slot].max(update)
+    return stamps
+
+
+def staleness_depth(stamps: jax.Array, tick: jax.Array, sched: CommSchedule,
+                    *, axis: Axis = "rank") -> jax.Array:
+    """Max staleness over this rank's *real* slots: ``tick - min(stamp)``.
+
+    Ranks with no in-edges report depth 0 — there is nobody to be stale
+    relative to.  Returns a scalar int32 (per rank under SPMD).
+    """
+    idx_tab = np.arange(max(sched.max_in_degree, 1))
+    real = jnp.asarray(
+        (idx_tab[:, None] < sched.in_degree[None, :]).astype(np.bool_))
+    rank = lax.axis_index(axis)
+    mask = real[:, rank]
+    tick = jnp.asarray(tick, jnp.int32)
+    oldest = jnp.min(jnp.where(mask, stamps, tick))
+    depth = tick - oldest
+    has_in = jnp.asarray(sched.in_degree > 0)[rank]
+    return jnp.where(has_in, depth, jnp.int32(0))
+
+
+def async_mixing_matrices(sched: CommSchedule,
+                          active: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Host-side model of one async gossip tick over the *extended* state.
+
+    The extended state stacks every rank's value with every mailbox slot:
+    index ``i`` (< n) is rank i's value, index ``n + i*K + k`` is rank i's
+    slot-k mailbox.  One tick factors into a push matrix ``P`` (active ranks
+    split their value between themselves and out-neighbor mailboxes with
+    weight ``1/(out_degree+1)``) and a collect matrix ``C`` (active ranks
+    fold all their mailboxes back into their value).  Push-sum runs the same
+    matrices over the mass lane, so column-stochasticity of ``C @ P`` for
+    *every* activity pattern is exactly the invariant that keeps the
+    de-biased mixing correct under arbitrary per-rank staleness — the
+    property test drives this helper with seeded activity vectors.
+    """
+    n = sched.size
+    K = max(sched.max_in_degree, 1)
+    m = n + n * K
+    active = np.asarray(active, dtype=bool)
+    if active.shape != (n,):
+        raise ValueError(f"active must have shape ({n},), got {active.shape}")
+
+    P = np.eye(m, dtype=np.float64)
+    for j in range(n):
+        if not active[j]:
+            continue
+        out_edges = []          # (dst_rank, dst_slot) for rank j's pushes
+        for r in range(sched.num_rounds):
+            for dst in range(n):
+                if sched.recv_src[r][dst] == j:
+                    out_edges.append((dst, int(sched.recv_slot[r][dst])))
+        w = 1.0 / (len(out_edges) + 1.0)
+        P[j, j] = w
+        for dst, slot in out_edges:
+            P[n + dst * K + slot, j] += w   # accumulate into the mailbox
+
+    C = np.eye(m, dtype=np.float64)
+    for i in range(n):
+        if not active[i]:
+            continue
+        for k in range(K):
+            C[i, n + i * K + k] = 1.0       # fold mailbox into value...
+            C[n + i * K + k, n + i * K + k] = 0.0   # ...and clear it
+    return P, C

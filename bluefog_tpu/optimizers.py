@@ -690,6 +690,211 @@ def push_schedule(topo=None, size: Optional[int] = None) -> CommSchedule:
     return compile_from_weights(n, keep, src)
 
 
+class AsyncGossipState(NamedTuple):
+    """Carry for :func:`async_window_gossip` (rides the fused-scan carry).
+
+    ``recv`` mirrors the params' fused buffers with one ``[K, ...]`` mailbox
+    block each; ``p``/``p_recv`` are the push-sum mass lane (a single scalar
+    for the whole model — every buffer gossips with the same activity
+    pattern, so one mass suffices); ``stamps`` are the per-slot step stamps
+    the bounded-staleness gate reads; ``local_steps`` counts the ticks this
+    rank actually worked; ``force`` is the fleet-wide sync-up flag for the
+    *next* tick; ``depth`` is last tick's staleness depth (the probe
+    surface :func:`bluefog_tpu.diagnostics.observe_async_staleness` reads).
+    """
+    recv: Any
+    p: jax.Array
+    p_recv: jax.Array
+    stamps: jax.Array
+    local_steps: jax.Array
+    force: jax.Array
+    depth: jax.Array
+
+
+def async_window_gossip(
+    opt: optax.GradientTransformation,
+    sched: Optional[CommSchedule] = None,
+    *,
+    axis: Axis = "rank",
+    staleness_bound: Optional[int] = None,
+    pace: Optional[Sequence[int]] = None,
+    fuse: bool = True,
+    wire: Optional[str] = None,
+) -> DecentralizedOptimizer:
+    """Bounded-staleness asynchronous window gossip (the paper's second half).
+
+    Reference: the WinPut/PushSum optimizer family over true one-sided RMA
+    (``optimizers.py:763-1160`` + the passive-recv thread): every rank runs
+    its local step loop at its own pace, pushes ``1/(outdeg+1)`` of its mass
+    into neighbor mailboxes via ``win_accumulate`` and proceeds *without
+    waiting*; receivers fold in whatever has arrived.  XLA programs are
+    bulk-synchronous, so pace heterogeneity is modeled inside the compiled
+    step: a static per-rank ``pace`` table marks rank r *active* on ticks
+    where ``tick % pace[r] == 0`` — an inactive tick is a rank still busy
+    with local compute, so it neither pushes, collects, nor adapts (its
+    mailboxes keep accumulating).  The harness (``tools/gossip_bench.py``)
+    turns that model into real wall clock: a lockstep fleet pays the
+    straggler's delay every tick, the async fleet only on forced sync-ups.
+
+    Correctness under partial activity is push-sum's: the mass scalar ``p``
+    travels through the *same* mailboxes with the same activity pattern, so
+    every tick's effective mixing over the extended (value ⊕ mailbox) state
+    is column-stochastic for ANY activity vector
+    (:func:`bluefog_tpu.ops.windows.async_mixing_matrices` is the host-side
+    model, property-tested) and the de-biased iterate ``z = x / p`` stays a
+    convex combination of the fleet's parameters — the staleness-aware
+    mixing correction.
+
+    The staleness bound K (``staleness_bound``, default from
+    :func:`bluefog_tpu.parallel.context.async_gossip_bound` /
+    ``BLUEFOG_ASYNC``): per-slot step stamps track each in-neighbor's most
+    recent delivery; when any rank's staleness depth exceeds K the whole
+    fleet is forced active on the next tick (a sync-up), bounding how far a
+    straggler's contribution can lag.  ``K=0`` statically forces every tick
+    active — exact synchronous lockstep, trajectory-identical to
+    combine-then-adapt on the same push schedule (the float64 oracle in
+    ``tests/test_async_gossip.py``).
+
+    Params carry the DE-BIASED iterate ``z`` (re-biased to ``x = z·p`` at
+    update entry), so rank-0 template broadcast in ``init_distributed``
+    and checkpoint surgery both see the quantity the model actually uses.
+    """
+    def _sched():
+        s = sched if sched is not None else _mesh.static_schedule()
+        if s.uses_dst_weighting:
+            raise ValueError(
+                "async_window_gossip requires column-stochastic push "
+                "weights (push_schedule), not a dst-weighted schedule")
+        return s
+
+    def _bound() -> int:
+        if staleness_bound is not None:
+            b = int(staleness_bound)
+        else:
+            b = _mesh.async_gossip_bound()
+        if b < 0:
+            raise ValueError(f"staleness_bound must be >= 0, got {b}")
+        return b
+
+    def _pace(n: int) -> np.ndarray:
+        if pace is None:
+            return np.ones(n, np.int32)
+        tab = np.asarray(pace, np.int32)
+        if tab.shape != (n,) or (tab < 1).any():
+            raise ValueError(
+                f"pace must be {n} ints >= 1, got {np.asarray(pace)!r}")
+        return tab
+
+    def _vals(params):
+        return fusion.fuse_tree(params).buffers if fuse else params
+
+    def init(params):
+        s = _sched()
+        _bound()                         # fail fast on a bad knob
+        K = max(s.max_in_degree, 1)
+        recv = jax.tree.map(
+            lambda x: jnp.zeros((K,) + x.shape, x.dtype), _vals(params))
+        return DecentralizedState(
+            jnp.zeros((), jnp.int32), opt.init(params),
+            AsyncGossipState(
+                recv=recv,
+                p=jnp.ones((), jnp.float32),
+                p_recv=jnp.zeros((K,), jnp.float32),
+                stamps=wops.stamp_create(s),
+                local_steps=jnp.zeros((), jnp.int32),
+                force=jnp.zeros((), jnp.bool_),
+                depth=jnp.zeros((), jnp.int32)))
+
+    def update(grads, state, params):
+        s = _sched()
+        bound = _bound()
+        cs: AsyncGossipState = state.comm_state
+        tick = state.step
+        idx = lax.axis_index(axis)
+        out_deg = jnp.asarray(s.out_degree)[idx]
+        sw = 1.0 / (out_deg.astype(jnp.float32) + 1.0)
+
+        if bound == 0:
+            # statically lockstep: the whole activity machinery folds away
+            # and the trajectory is exactly synchronous CTA on push weights
+            active = jnp.ones((), jnp.bool_)
+        else:
+            scheduled = (tick % jnp.asarray(_pace(s.size))[idx]) == 0
+            active = jnp.logical_or(scheduled, cs.force)
+
+        recipe = fusion.fuse_tree(params) if fuse else None
+        z_vals = recipe.buffers if fuse else params
+        p = cs.p
+
+        def gossip(w: wops.Window) -> wops.Window:
+            # rebias z -> x = z*p, push 1/(outdeg+1) of x along out-edges
+            # (wire-codec'd), then — if active — collect: keep the same
+            # fraction of x and fold in every mailbox.  Inactive ticks
+            # deliver nothing, collect nothing: mailboxes keep accumulating.
+            z = w.value
+            dt = z.dtype
+            x = z * p.astype(dt)
+            send = x * jnp.where(active, sw, 0.0).astype(dt)
+            w = wops.win_accumulate(
+                wops.Window(value=x, recv=w.recv), send, s, axis=axis,
+                wire=wire)
+            # unreal slots (beyond in_degree) never receive and start at
+            # zero, so the plain sum over K equals the real-slot sum
+            mailbox = jnp.sum(w.recv.astype(dt), axis=0)
+            mixed = (jnp.where(active, sw, 1.0).astype(dt) * x
+                     + jnp.where(active, mailbox, jnp.zeros_like(mailbox)))
+            new_recv = jnp.where(active, jnp.zeros_like(w.recv), w.recv)
+            return wops.Window(value=mixed, recv=new_recv)
+
+        with named_span("COMMUNICATE"):
+            wins = jax.tree.map(wops.Window, z_vals, cs.recv)
+            wins = _map_windows(gossip, wins)
+            mixed_vals = _map_windows(lambda w: w.value, wins)
+            new_recv = _map_windows(lambda w: w.recv, wins)
+            # mass lane: same mailboxes, same activity, no wire codec
+            # (a quantized p would bias the correction it exists to apply)
+            pwin = wops.win_accumulate(
+                wops.Window(value=p, recv=cs.p_recv),
+                p * jnp.where(active, sw, 0.0), s, axis=axis)
+            p_mixed = jnp.where(
+                active, sw * p + jnp.sum(pwin.recv), p)
+            new_p_recv = jnp.where(
+                active, jnp.zeros_like(pwin.recv), pwin.recv)
+            stamps = wops.stamp_push(cs.stamps, tick, active, s, axis=axis)
+
+        depth = wops.staleness_depth(stamps, tick, s, axis=axis)
+        if bound == 0:
+            force_next = jnp.zeros((), jnp.bool_)
+        else:
+            force_next = lax.pmax(depth, axis) > bound
+
+        # de-bias; inactive ranks see p_mixed == p and mixed == x, so their
+        # z is algebraically unchanged (masked below to keep it bit-exact)
+        z_mixed = jax.tree.map(
+            lambda m: m / p_mixed.astype(m.dtype), mixed_vals)
+        if fuse:
+            recipe.buffers = z_mixed
+            z_tree = recipe.unfuse()
+        else:
+            z_tree = z_mixed
+        adapted, new_opt_state = _apply(opt, grads, state.opt_state, z_tree)
+        # an inactive rank is mid-local-compute: no adapt lands, params and
+        # optimizer state freeze until its next active tick
+        new_params = jax.tree.map(
+            lambda a, orig: jnp.where(active, a, orig), adapted, params)
+        opt_state = jax.tree.map(
+            lambda nw, od: jnp.where(active, nw, od),
+            new_opt_state, state.opt_state)
+        return new_params, DecentralizedState(
+            state.step + 1, opt_state,
+            AsyncGossipState(
+                recv=new_recv, p=p_mixed, p_recv=new_p_recv, stamps=stamps,
+                local_steps=cs.local_steps + active.astype(jnp.int32),
+                force=force_next, depth=depth))
+
+    return DecentralizedOptimizer(init, update, (axis,))
+
+
 def push_diging(
     opt: optax.GradientTransformation,
     sched: Optional[CommSchedule] = None,
@@ -1260,6 +1465,15 @@ def _reg_choco(opt, *, schedule=None, wire=None, concurrent=None,
     return choco_gossip(opt, schedule, wire=wire if wire else "int8")
 
 
+def _reg_async_window_gossip(opt, *, schedule=None, wire=None,
+                             concurrent=None, delayed=False,
+                             num_steps_per_communication=1):
+    # pace/staleness_bound come from the context knob (BLUEFOG_ASYNC /
+    # set_async_gossip), not the autotune axes: the tuner picks sync-vs-
+    # async as an *algorithm*, the operator tunes the bound per fleet
+    return async_window_gossip(opt, schedule, wire=wire)
+
+
 #: Name -> :class:`StrategySpec` for every strategy the autotuner can pick.
 STRATEGIES = {
     "allreduce": StrategySpec(
@@ -1287,6 +1501,9 @@ STRATEGIES = {
         _reg_choco, uses_schedule=True, wire_aware=True,
         concurrent_aware=False, pipelined_ok=False,
         weights=("recv", "dst")),
+    "async_window_gossip": StrategySpec(
+        _reg_async_window_gossip, uses_schedule=True, wire_aware=True,
+        concurrent_aware=False, pipelined_ok=False, weights=("push",)),
 }
 
 
@@ -1328,6 +1545,9 @@ def strategy_constraint_violation(
                 if name == "push_sum" else
                 "push_diging requires column-stochastic push weights "
                 "(push_schedule), not a dst-weighted schedule")
+    if name == "async_window_gossip" and dst:
+        return ("async_window_gossip requires column-stochastic push "
+                "weights (push_schedule), not a dst-weighted schedule")
     if name == "choco" and dst:
         from .ops.collectives import _parse_wire
         w = wire if wire else "int8"
@@ -1542,6 +1762,10 @@ class _InstrumentedStep:
         k = self._metrics_every_k
         if k and (self._calls == 1 or self._calls % k == 0):
             _diag.diagnose_consensus(out[0], step_times=step_times)
+            # async-gossip states carry their staleness depth in the step
+            # output — a pure host read, no extra collective or compile
+            if len(out) > 1:
+                _diag.observe_async_staleness(out[1])
         if self._calls >= self._warmup:
             size = self._jit_cache_len()
             if (_metrics.in_steady_state() and size is not None

@@ -34,6 +34,8 @@ from .context import (
     round_parallel,
     set_dcn_wire,
     dcn_wire,
+    set_async_gossip,
+    async_gossip_bound,
 )
 
 __all__ = [
@@ -49,11 +51,13 @@ __all__ = [
     "set_dynamic_topology", "clear_dynamic_topology", "dynamic_schedules",
     "set_round_parallel", "round_parallel", "apply_plan",
     "set_dcn_wire", "dcn_wire",
+    "set_async_gossip", "async_gossip_bound",
 ]
 
 from .windows import (
     win_create, win_free, win_put, win_accumulate, win_get,
     win_update, win_update_then_collect, win_mutex, get_win_version,
+    get_win_stamps, win_staleness,
     win_associated_p,
     turn_on_win_ops_with_associated_p, turn_off_win_ops_with_associated_p,
 )
@@ -61,6 +65,7 @@ from .windows import (
 __all__ += [
     "win_create", "win_free", "win_put", "win_accumulate", "win_get",
     "win_update", "win_update_then_collect", "win_mutex", "get_win_version",
+    "get_win_stamps", "win_staleness",
     "win_associated_p",
     "turn_on_win_ops_with_associated_p", "turn_off_win_ops_with_associated_p",
 ]

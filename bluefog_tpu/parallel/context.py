@@ -49,6 +49,9 @@ class BlueFogTpuContext:
     # process default for the DCN-hop wire codec of hierarchical gossip
     # (None = defer to BLUEFOG_DCN_WIRE; "off" forces full width)
     dcn_wire: Optional[str] = None
+    # process default staleness bound for async window gossip (None = defer
+    # to BLUEFOG_ASYNC; 0 forces synchronous lockstep)
+    async_staleness: Optional[int] = None
     # how the machine grouping was derived ("auto" = from the device mesh /
     # slice_index at init; None = manual nodes_per_machine / set_machine_topology)
     hierarchical: Optional[str] = None
@@ -562,6 +565,48 @@ def set_dcn_wire(value: Optional[str]) -> None:
 def dcn_wire() -> Optional[str]:
     """The context's DCN-wire default (see :func:`set_dcn_wire`)."""
     return get_context().dcn_wire
+
+
+#: Default staleness bound when neither the knob nor BLUEFOG_ASYNC is set:
+#: deep enough to absorb a ~5x pace spread on the fleet's slowest rank
+#: before the first forced sync-up, shallow enough that a stuck rank is
+#: dragged back within a handful of ticks.
+_DEFAULT_ASYNC_BOUND = 4
+
+
+def set_async_gossip(bound: Optional[int]) -> None:
+    """Set the process default staleness bound K for
+    :func:`bluefog_tpu.optimizers.async_window_gossip`.
+
+    ``K=0`` forces synchronous lockstep (every tick active — the oracle
+    mode); ``K>0`` lets ranks free-run until some neighbor contribution is
+    more than K ticks stale, at which point the whole fleet syncs up on the
+    next tick.  ``None`` defers to the ``BLUEFOG_ASYNC`` env var (and its
+    default).  A per-strategy ``staleness_bound=`` argument always wins.
+    Like ``set_round_parallel``, the bound is resolved at trace time and is
+    part of the compiled program: flip it before warmup, or the retrace
+    sentinel will count the recompile it causes.
+    """
+    if bound is not None and int(bound) < 0:
+        raise ValueError(f"staleness bound must be >= 0, got {bound}")
+    get_context().async_staleness = None if bound is None else int(bound)
+
+
+def async_gossip_bound() -> int:
+    """The resolved async staleness bound: context knob, else the
+    ``BLUEFOG_ASYNC`` env var, else ``_DEFAULT_ASYNC_BOUND``
+    (see :func:`set_async_gossip`)."""
+    ctx = get_context()
+    if ctx.async_staleness is not None:
+        return ctx.async_staleness
+    env = os.environ.get("BLUEFOG_ASYNC", "").strip()
+    if env:
+        bound = int(env)
+        if bound < 0:
+            raise ValueError(
+                f"BLUEFOG_ASYNC must be >= 0, got {env!r}")
+        return bound
+    return _DEFAULT_ASYNC_BOUND
 
 
 def apply_plan(plan) -> bool:

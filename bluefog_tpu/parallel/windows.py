@@ -38,6 +38,7 @@ from . import context as _mesh
 __all__ = [
     "win_create", "win_free", "win_put", "win_accumulate", "win_get",
     "win_update", "win_update_then_collect", "win_mutex", "get_win_version",
+    "get_win_stamps", "win_staleness",
     "win_associated_p", "turn_on_win_ops_with_associated_p",
     "turn_off_win_ops_with_associated_p",
 ]
@@ -48,6 +49,11 @@ class _WindowEntry:
     window: wops.Window          # distributed: value [n,...], recv [n,K,...]
     sched: CommSchedule          # creation-time schedule (defines slots)
     version: np.ndarray          # [n, K] puts delivered per mailbox (host-side)
+    # bounded-staleness bookkeeping (the named-window face of the async
+    # strategy's per-slot stamps): `tick` counts delivery ops dispatched on
+    # this window, `stamp[d, k]` the tick of slot k's most recent delivery
+    stamp: np.ndarray = None     # [n, K] host-side, int64
+    tick: int = 0
 
 
 _registry: Dict[str, _WindowEntry] = {}
@@ -145,7 +151,8 @@ def win_create(tensor: jax.Array, name: str, zero_init: bool = False) -> bool:
     win = fn(tensor)
     _registry[name] = _WindowEntry(
         window=win, sched=sched,
-        version=np.zeros((ctx.size, max(sched.max_in_degree, 1)), dtype=np.int64))
+        version=np.zeros((ctx.size, max(sched.max_in_degree, 1)), dtype=np.int64),
+        stamp=np.zeros((ctx.size, max(sched.max_in_degree, 1)), dtype=np.int64))
     # associated-P channel: one scalar per rank, same mailbox layout
     pfn = _cached(
         ("create-p", sched, ctx.mesh, tensor.dtype.name),
@@ -250,7 +257,10 @@ def _move(kind: str, tensor_or_none, name: str, dst_weights,
     # eager op API — chaos may stall this op or NaN the window payload
     if _chaos._plan is not None:
         entry.window = _chaos.on_eager_op("win_" + kind, entry.window)
-    entry.version += _delivered_mask(sched, slots)
+    mask = _delivered_mask(sched, slots)
+    entry.version += mask
+    entry.tick += 1
+    entry.stamp[mask] = entry.tick
 
 
 def _mesh_check(x, n):
@@ -386,6 +396,26 @@ def get_win_version(name: str) -> np.ndarray:
     """[n, max_in_degree] count of puts delivered per mailbox since the last
     reset (reference: version windows, ``mpi_controller.cc:1284-1392``)."""
     return _entry(name).version.copy()
+
+
+def get_win_stamps(name: str) -> np.ndarray:
+    """[n, max_in_degree] tick of each mailbox's most recent delivery (0 =
+    never delivered).  The window's tick advances once per put / accumulate
+    / get dispatched on it — the named-window face of the async strategy's
+    per-slot step stamps."""
+    return _entry(name).stamp.copy()
+
+
+def win_staleness(name: str) -> np.ndarray:
+    """[n, max_in_degree] delivery-ops-ago of each real mailbox's freshest
+    contribution (``tick - stamp``); slots a schedule never delivers to
+    report 0.  The bounded-staleness gate of
+    :func:`bluefog_tpu.optimizers.async_window_gossip` is the compiled-step
+    sibling of this host-side view."""
+    entry = _entry(name)
+    slots = entry.stamp.shape[1]
+    real = _delivered_mask(entry.sched, slots)
+    return np.where(real, entry.tick - entry.stamp, 0)
 
 
 def win_associated_p(name: str) -> jax.Array:

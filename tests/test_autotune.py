@@ -117,7 +117,7 @@ def test_plan_rejects_foreign_schema():
 
 def test_full_space_rejections_carry_constructor_reasons():
     accepted, rejected = enumerate_candidates(N)
-    assert len(accepted) == 152 and len(rejected) == 36
+    assert len(accepted) == 164 and len(rejected) == 48
     assert all(r["reason"] for r in rejected)
     reasons = {r["key"]: r["reason"] for r in rejected}
     # the deliberately-enumerated contract violations surface with the
@@ -131,6 +131,9 @@ def test_full_space_rejections_carry_constructor_reasons():
     assert any(k.startswith("choco") and "wire=bf16" in k
                and "weights=dst" in k
                and "does not commute with send scaling" in v
+               for k, v in reasons.items())
+    assert any(k.startswith("async_window_gossip") and "weights=dst" in k
+               and "column-stochastic push weights" in v
                for k, v in reasons.items())
     # and nothing rejected ever shows up accepted
     assert not {c.key for c in accepted} & set(reasons)

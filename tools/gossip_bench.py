@@ -16,6 +16,18 @@ leaders) on spectral gap per cross-slice (DCN) byte.  Pure host math — no
 mesh, no jit — so it runs at 32x128 (4096 chips) in milliseconds:
     python tools/gossip_bench.py --frontier --shapes 32x32,32x128 \
         --wire bf16 --out /tmp/frontier.json
+
+``--async-frontier`` grades the straggler-immunity claim of
+``async_window_gossip``: one rank throttled ``--throttle-factor`` x on
+Exp2(n), wall-clock until the fleet's max consensus distance contracts to
+``--target-ratio`` of its initial value, synchronous lockstep (staleness
+bound 0, the straggler's sleep charged to EVERY tick via a chaos
+``throttle`` fault — the PR 5 delay ledger keeps the attribution
+reproducible) vs bounded-staleness async (the straggler only completes a
+step every ``factor`` ticks; the fleet pays its delay only on the forced
+sync-ups the staleness bound triggers):
+    python tools/gossip_bench.py --async-frontier --virtual-cpu \
+        --params 4096 --out /tmp/async_frontier.json
 """
 import argparse
 import json
@@ -125,6 +137,161 @@ def _frontier(args):
     return report
 
 
+def _async_frontier(args):
+    """Wall-clock-to-consensus, sync vs bounded-staleness async gossip.
+
+    Both arms run the SAME strategy (``async_window_gossip`` on the same
+    column-stochastic push schedule) so the comparison isolates the
+    asynchrony: the sync arm pins staleness bound 0 (statically lockstep —
+    trajectory-identical to combine-then-adapt) and pays the straggler's
+    sleep on every tick through a chaos ``throttle`` fault; the async arm
+    models the straggler with a pace table (its step completes — and its
+    ``win_accumulate`` lands — only every ``factor``-th tick) and the fleet
+    sleeps only when the staleness bound forces a sync-up.  Wall clock
+    counts step dispatch + injected sleeps; the consensus probe between
+    ticks is excluded (both arms pay it identically).
+    """
+    if args.virtual_cpu:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+    if args.virtual_cpu:
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    import bluefog_tpu as bf
+    from bluefog_tpu import optimizers as bfopt
+    from bluefog_tpu import topology as tu
+    from bluefog_tpu.utils import chaos
+
+    bf.init(platform="cpu" if args.virtual_cpu else None)
+    n = bf.size()
+    bf.set_topology(tu.ExponentialTwoGraph(n))
+    sched = bfopt.push_schedule(bf.load_topology(), n)
+    rank = args.throttle_rank % n
+    factor = args.throttle_factor
+    bound = args.staleness_bound
+    opt = optax.sgd(0.0)           # pure gossip: grade mixing, not descent
+
+    rng = np.random.RandomState(7)
+    params0 = {"w": jnp.asarray(rng.randn(n, args.params).astype(np.float32))}
+    batch = jnp.zeros((n, 1))
+
+    def grad_fn(p, _):
+        return jnp.zeros(()), jax.tree.map(jnp.zeros_like, p)
+
+    def build(strat):
+        # pre-shard everything onto the mesh: feeding uncommitted host
+        # arrays would make the post-warmup call (whose inputs are the
+        # sharded step outputs) retrace, polluting both the timing and
+        # the retrace sentinel
+        step = bfopt.make_train_step(grad_fn, strat, donate=False)
+        shard = lambda t: jax.tree.map(bf.shard_distributed, t)
+        params = shard(jax.tree.map(jnp.copy, params0))
+        state = shard(bfopt.init_distributed(strat, params))
+        step(params, state, batch)            # compile, untimed
+        return step, params, state
+
+    def consensus_max(p):
+        return float(bf.consensus_distance(p).max())
+
+    # unthrottled tick time on this backend -> the injected straggler delay
+    step, params, state = build(
+        bfopt.async_window_gossip(opt, sched, staleness_bound=0))
+    initial = consensus_max(params)   # warm the probe on SHARDED params
+    times = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        params, state, _ = step(params, state, batch)
+        bf.hard_sync(params)
+        times.append(time.perf_counter() - t0)
+    base = float(np.median(times))
+    throttle_s = max((factor - 1) * base, 0.02)
+    target = args.target_ratio * initial
+
+    def run(strat, straggler_sleeps: bool):
+        step, params, state = build(strat)
+        wall, ticks, forced, stale_max = 0.0, 0, 0, 0
+        stall_next = False
+        while ticks < args.max_ticks:
+            t0 = time.perf_counter()
+            if straggler_sleeps and stall_next:
+                # the fleet blocks on the straggler finishing its step
+                # before the forced sync-up tick can run
+                time.sleep(throttle_s)
+                forced += 1
+            params, state, _ = step(params, state, batch)
+            bf.hard_sync(params)
+            wall += time.perf_counter() - t0
+            ticks += 1
+            if straggler_sleeps:
+                stall_next = bool(np.asarray(state.comm_state.force).any())
+                stale_max = max(
+                    stale_max, int(np.asarray(state.comm_state.depth).max()))
+            if consensus_max(params) <= target:
+                break
+        return {"ticks": ticks, "wall_s": round(wall, 6),
+                "reached_target": consensus_max(params) <= target,
+                **({"forced_syncs": forced, "staleness_max": stale_max}
+                   if straggler_sleeps else {})}
+
+    # sync arm: lockstep program, chaos throttle charges the straggler's
+    # delay to every tick (the whole fleet waits at the barrier)
+    chaos.install(f"throttle:from=1,t={throttle_s},rank={rank}")
+    try:
+        sync_row = run(
+            bfopt.async_window_gossip(opt, sched, staleness_bound=0),
+            straggler_sleeps=False)
+    finally:
+        chaos.uninstall()
+
+    # async arm: straggler completes a step every `factor` ticks (pace
+    # table); the fleet sleeps only before bound-forced sync-ups
+    pace = [factor if r == rank else 1 for r in range(n)]
+    async_row = run(
+        bfopt.async_window_gossip(opt, sched, staleness_bound=bound,
+                                  pace=pace),
+        straggler_sleeps=True)
+
+    speedup = sync_row["wall_s"] / max(async_row["wall_s"], 1e-9)
+    report = {
+        "schema": "bluefog-gossip-async-1",
+        "n": n, "topology": f"expo2({n})", "params": args.params,
+        "staleness_bound": bound, "target_ratio": args.target_ratio,
+        "base_tick_s": round(base, 6),
+        "throttle": {"rank": rank, "factor": factor,
+                     "t_s": round(throttle_s, 6)},
+        "sync": sync_row, "async": async_row,
+        "speedup": round(speedup, 3),
+        "won": bool(async_row["wall_s"] < sync_row["wall_s"]
+                    and async_row["reached_target"]
+                    and sync_row["reached_target"]),
+    }
+
+    print(f"async frontier: expo2({n}), {args.params} f32/rank, rank {rank} "
+          f"throttled {factor}x ({throttle_s * 1e3:.0f} ms/tick), "
+          f"staleness bound {bound}, target {args.target_ratio:.2f}x initial "
+          f"consensus:")
+    print(f"{'arm':>8} {'ticks':>6} {'wall s':>8} {'forced':>7} "
+          f"{'max stale':>10}")
+    print(f"{'sync':>8} {sync_row['ticks']:>6} {sync_row['wall_s']:>8.3f} "
+          f"{'-':>7} {'-':>10}")
+    print(f"{'async':>8} {async_row['ticks']:>6} "
+          f"{async_row['wall_s']:>8.3f} {async_row['forced_syncs']:>7} "
+          f"{async_row['staleness_max']:>10}")
+    print(f"async-to-consensus is {speedup:.2f}x "
+          f"{'faster' if report['won'] else 'SLOWER'} than sync under a "
+          f"{factor}x straggler")
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=2)
+        print(f"wrote {args.out}")
+    return report
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--virtual-cpu", action="store_true")
@@ -147,10 +314,27 @@ def main():
                              "schedule in --frontier")
     parser.add_argument("--out", default=None,
                         help="write the --frontier report as JSON here")
+    parser.add_argument("--async-frontier", action="store_true",
+                        help="grade sync vs bounded-staleness async gossip "
+                             "wall-clock-to-consensus under a throttled rank")
+    parser.add_argument("--throttle-rank", type=int, default=3,
+                        help="rank the async frontier throttles")
+    parser.add_argument("--throttle-factor", type=int, default=10,
+                        help="slowdown factor of the throttled rank")
+    parser.add_argument("--staleness-bound", type=int, default=4,
+                        help="async staleness bound K for --async-frontier")
+    parser.add_argument("--target-ratio", type=float, default=0.05,
+                        help="stop when max consensus distance falls to this "
+                             "fraction of its initial value")
+    parser.add_argument("--max-ticks", type=int, default=400,
+                        help="per-arm tick budget for --async-frontier")
     args = parser.parse_args()
 
     if args.frontier:
         _frontier(args)
+        return
+    if args.async_frontier:
+        _async_frontier(args)
         return
 
     if args.virtual_cpu:

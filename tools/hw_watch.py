@@ -222,6 +222,14 @@ def _battery_steps(tag: str, stage: int = 0) -> list:
                        "--pp", "2", "--out",
                        os.path.join(m, f"serve_bench_{tag}.json")],
                       2400, None, None))
+    # the async-gossip headline: one rank throttled 10x on the real mesh,
+    # async wall-clock-to-consensus vs lockstep on the same push schedule
+    # (cheap: two small-strategy compiles, tens of gossip ticks)
+    steps.append(("async_frontier",
+                  [py, os.path.join(REPO, "tools", "gossip_bench.py"),
+                   "--async-frontier",
+                   "--out", os.path.join(m, f"async_frontier_{tag}.json")],
+                  1200, None, None))
     # 1,5,10 not 1,2,5,10: one fewer ResNet compile (~5 min of window)
     # and k=2 adds nothing the amortization curve needs
     steps.append(("step_sweep",
@@ -292,6 +300,11 @@ def _rehearsal_steps(tag: str) -> list:
          [py, os.path.join(REPO, "tools", "serve_bench.py"),
           "--virtual-cpu", "--smoke",
           "--out", os.path.join(m, f"serve_bench_{tag}.json")], 900,
+         None, None),
+        ("async_frontier",
+         [py, os.path.join(REPO, "tools", "gossip_bench.py"),
+          "--async-frontier", "--virtual-cpu", "--params", "2048",
+          "--out", os.path.join(m, f"async_frontier_{tag}.json")], 600,
          None, None),
         ("step_sweep",
          [py, os.path.join(REPO, "tools", "step_sweep.py"),

@@ -7,7 +7,8 @@ PY ?= python
 .PHONY: test test-fast test_basic test_ops test_win_ops test_optimizer \
 	test_hier test_native test_examples verify native clean hw-watch \
 	obs-smoke chaos-smoke overlap-smoke postmortem-smoke pod-smoke \
-	autotune-smoke elastic-smoke lm-smoke serve-smoke async-smoke
+	autotune-smoke elastic-smoke lm-smoke serve-smoke serve-fast-smoke \
+	async-smoke
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -176,7 +177,7 @@ serve-smoke:
 		--out /tmp/serve_bench_smoke.json
 	$(PY) -c "import json; \
 		d = json.load(open('/tmp/serve_bench_smoke.json')); \
-		assert d['schema'] == 'bluefog-serve-bench-1' and d['ok'], d; \
+		assert d['schema'] == 'bluefog-serve-bench-2' and d['ok'], d; \
 		i = d['invariants']; \
 		assert i['donation_intact'] and \
 		i['retraces_after_warmup'] == 0, i; \
@@ -186,6 +187,29 @@ serve-smoke:
 		assert d['refresh']['pulls'] >= 1, d; \
 		assert d['latency']['per_token_p50_s'] > 0, d; \
 		print('serve-smoke OK')"
+
+# serving fast-path smoke: the fast-path test battery (speculative
+# bit-identity, prefix CoW, KV-quantization drift oracle, fused sampling
+# determinism) plus serve_bench with all three axes armed — spec decode
+# 3-deep, int8 KV pages, shared prefix pages — gated on the schema-2
+# fast rows (bit_identical, hit_faster, int8 ratio <= 0.5)
+serve-fast-smoke:
+	$(PY) -m pytest tests/test_serve_fast.py -q -m "not slow"
+	$(PY) tools/serve_bench.py --virtual-cpu --smoke \
+		--spec-decode 3@1 --kv-dtype int8 --prefix-pages 2x8 \
+		--out /tmp/serve_bench_fast_smoke.json
+	$(PY) -c "import json; \
+		d = json.load(open('/tmp/serve_bench_fast_smoke.json')); \
+		assert d['schema'] == 'bluefog-serve-bench-2' and d['ok'], d; \
+		s = d['spec']; \
+		assert s['bit_identical'] and s['drafted'] > 0, s; \
+		p = d['prefix']; \
+		assert p['hit_faster'] and p['hits'] >= 1 and \
+		p['tokens_identical'], p; \
+		k = d['kv']; \
+		assert k['ratio'] <= 0.5, k; \
+		assert d['invariants']['retraces_after_warmup'] == 0, d; \
+		print('serve-fast-smoke OK')"
 
 # resilience smoke: deterministic fault injection + healing/rollback on
 # the virtual CPU mesh (kill->heal->contract, NaN->rollback, restart

@@ -37,6 +37,28 @@ def apply_rope(x: jax.Array, positions: jax.Array,
         [x1 * cos - x2 * sin, x1 * sin + x2 * cos], -1).astype(x.dtype)
 
 
+def apply_rope_grid(x: jax.Array, positions: jax.Array,
+                    base: float = 10000.0) -> jax.Array:
+    """Rotary position embedding on ``[S, T, H, D]`` with a PER-ROW grid of
+    ``positions`` ([S, T] int) — the k-token verify forward and chunked
+    prefill, where each batched request's T-token chunk starts at its own
+    sequence offset.  Same channel pairing and f32 internals as
+    :func:`apply_rope`, so a token roped here matches the one roped during
+    prefill or single-token decode bit-for-bit."""
+    d = x.shape[-1]
+    if d % 2:
+        raise ValueError(f"rope needs an even head_dim, got {d}: the "
+                         "rotation pairs channel i with channel i + d//2")
+    half = d // 2
+    freqs = base ** (-jnp.arange(half, dtype=jnp.float32) / half)
+    ang = positions.astype(jnp.float32)[..., None] * freqs   # [S, T, half]
+    cos = jnp.cos(ang)[:, :, None, :]
+    sin = jnp.sin(ang)[:, :, None, :]
+    x1, x2 = x[..., :half].astype(jnp.float32), x[..., half:].astype(jnp.float32)
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], -1).astype(x.dtype)
+
+
 def apply_rope_rows(x: jax.Array, positions: jax.Array,
                     base: float = 10000.0) -> jax.Array:
     """Rotary position embedding on ``[B, H, D]`` with PER-ROW ``positions``

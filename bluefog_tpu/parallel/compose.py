@@ -322,6 +322,74 @@ class LMConfig:
                 + 6.0 * self.layers * self.d_model * self.seq_len)
 
 
+@dataclasses.dataclass(frozen=True)
+class DraftCarve:
+    """A truncated-stage draft sub-model carved from a served carving.
+
+    Self-speculative decoding drafts with the FIRST ``stages`` pipeline
+    stages of the very model being served (early-exit: run stages
+    ``0 .. stages-1``, then the shared LN + head directly on that
+    activation).  No extra weights exist anywhere — the draft is a
+    prefix of the target's own pipeline cycle on the same mesh, which is
+    what makes its early-layer KV writes bit-identical to the target's
+    and lets the verify pass reuse them.  The carve is pure metadata:
+    the engine uses it to size the truncated ``ppermute`` cycle, and
+    serve_bench uses ``cost_fraction`` to price a draft token against a
+    target token when reporting the speculative speedup model.
+    """
+    stages: int            # pipeline stages the draft runs (1 .. pp)
+    pp: int                # target pipeline depth it was carved from
+    layers: int            # decoder blocks the draft runs
+    total_layers: int      # decoder blocks in the target
+    n_params: int          # dense draft params (blocks run + embed/head)
+    target_params: int     # dense target params
+
+    @property
+    def logit_stage(self) -> int:
+        """Mesh stage holding the draft's final activation: the truncated
+        cycle still ``ppermute``\\ s after every stage, so after ``stages``
+        hops the activation sits at stage ``stages % pp`` (``0`` for the
+        full cycle — the same stage the target reads logits from)."""
+        return self.stages % self.pp
+
+    @property
+    def cost_fraction(self) -> float:
+        """Draft-token FLOPs as a fraction of a target token's — the
+        ``c`` in the Leviathan et al. speedup model ``(1 - a^(k+1)) /
+        ((1 - a) (ck + 1))``."""
+        return self.n_params / self.target_params
+
+    def describe(self) -> dict:
+        return {"stages": self.stages, "pp": self.pp,
+                "layers": self.layers, "total_layers": self.total_layers,
+                "cost_fraction": round(self.cost_fraction, 4)}
+
+
+def draft_carve(m: Mesh3D, cfg: LMConfig, stages: int) -> DraftCarve:
+    """Carve the truncated-stage draft for self-speculative decoding.
+
+    ``stages`` counts pipeline stages off the front of the carving
+    (``1 <= stages <= m.pp``; ``stages == m.pp`` is the degenerate
+    identity draft — valid, every token accepted, no speedup).  The same
+    sub-mesh discipline as the PR 9 trajectory oracle: nothing is
+    resharded, the draft is a prefix of the already-compiled stage loop.
+    """
+    if not isinstance(stages, int) or not 1 <= stages <= m.pp:
+        raise ValueError(f"draft stages={stages!r} must be an int in "
+                         f"[1, pp={m.pp}]")
+    cfg.validate(m)
+    Lps = cfg.layers // m.pp
+    draft_layers = stages * Lps
+    D, F = cfg.d_model, cfg.ffn_mult * cfg.d_model
+    per_block = D * 3 * D + D * D + D * F + F * D
+    shared = 2 * cfg.vocab * D
+    return DraftCarve(
+        stages=stages, pp=m.pp, layers=draft_layers,
+        total_layers=cfg.layers,
+        n_params=draft_layers * per_block + shared,
+        target_params=cfg.n_params)
+
+
 def init_lm_params(cfg: LMConfig, m: Mesh3D, seed: int = 0) -> Any:
     """Distributed LM params: every leaf stacked ``[n, ...]`` along the one
     collapsed device axis.  Device ``(r, s, t, u)`` holds the blocks of its

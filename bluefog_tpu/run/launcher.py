@@ -144,6 +144,17 @@ def build_parser() -> argparse.ArgumentParser:
                    help="serving shape buckets '<batch,..>@<prompt_len,..>' "
                         "e.g. '1,2,4@16,64,256' (sets "
                         "BLUEFOG_SERVE_BUCKETS; see ServeConfig.from_env)")
+    p.add_argument("--spec-decode", default=None,
+                   help="self-speculative decoding '<k>' or '<k>@<stages>' "
+                        "draft depth / draft pipeline stages (sets "
+                        "BLUEFOG_SPEC_DECODE; see ServeConfig.from_env)")
+    p.add_argument("--kv-dtype", default=None,
+                   choices=("raw", "int8", "fp8"),
+                   help="KV cache page storage (sets BLUEFOG_KV_DTYPE)")
+    p.add_argument("--prefix-pages", default=None,
+                   help="shared prefix pages '<pages>' or "
+                        "'<pages>x<page_tokens>' (sets "
+                        "BLUEFOG_PREFIX_PAGES; see ServeConfig.from_env)")
     p.add_argument("--refresh-every", type=int, default=None,
                    help="serving weight refresh: pull fresh params from "
                         "the training fleet every N train steps (sets "
@@ -203,6 +214,12 @@ def _child_env(args) -> dict:
         env["BLUEFOG_SERVE"] = "1"
     if args.serve_buckets:
         env["BLUEFOG_SERVE_BUCKETS"] = args.serve_buckets
+    if args.spec_decode:
+        env["BLUEFOG_SPEC_DECODE"] = args.spec_decode
+    if args.kv_dtype:
+        env["BLUEFOG_KV_DTYPE"] = args.kv_dtype
+    if args.prefix_pages:
+        env["BLUEFOG_PREFIX_PAGES"] = args.prefix_pages
     if args.refresh_every is not None:
         env["BLUEFOG_REFRESH_EVERY"] = str(args.refresh_every)
     if not args.no_xla_tuning:

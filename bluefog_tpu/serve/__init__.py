@@ -21,11 +21,12 @@ Surface:
   launches by default.
 """
 from .engine import ServeConfig, ServeEngine
-from .kv_cache import KVCacheConfig, SlotAllocator, init_cache
+from .kv_cache import KVCacheConfig, PrefixCache, SlotAllocator, init_cache
 from .refresh import WeightRefresher
 from .scheduler import Request, Scheduler
 
 __all__ = [
-    "ServeConfig", "ServeEngine", "KVCacheConfig", "SlotAllocator",
-    "init_cache", "Request", "Scheduler", "WeightRefresher",
+    "ServeConfig", "ServeEngine", "KVCacheConfig", "PrefixCache",
+    "SlotAllocator", "init_cache", "Request", "Scheduler",
+    "WeightRefresher",
 ]

@@ -13,16 +13,37 @@ cache finite and the retrace sentinel at 0.
 Shapes are **bucketed**: decode batches only ever have the lane counts in
 ``ServeConfig.batch_buckets`` and prompts are padded to the lengths in
 ``prefill_buckets``.  :meth:`ServeEngine.warmup` compiles every declared
-bucket up front; afterwards the engine snapshots both jit caches and any
+bucket up front; afterwards the engine snapshots all jit caches and any
 growth fires :func:`bluefog_tpu.utils.metrics.note_retrace` — the same
 sentinel a training step uses, so one gauge covers the whole fleet.
 
 The KV cache is a donated argument threaded through a ``lax.scan`` decode
-carry (:mod:`.kv_cache` owns the layout); steady-state decode is a single
-cached program per (bucket, steps_per_call): embed → pp-cycle of
-stage-local layer scans (``ppermute`` moves the activation, a stage-id
-``where`` keeps exactly one stage's work) → stage-0 logits ``psum`` →
-greedy argmax, fused over ``decode_steps_per_call`` tokens.
+carry (:mod:`.kv_cache` owns the layout, including int8/fp8 page storage
+and shared prefix pages); steady-state decode is a single cached program
+per (bucket, steps_per_call): embed → pp-cycle of stage-local layer
+scans (``ppermute`` moves the activation, a stage-id ``where`` keeps
+exactly one stage's work) → stage-0 logits ``psum`` → greedy argmax or
+the fused temperature/top-p sampler, fused over ``decode_steps_per_call``
+tokens.
+
+Fast paths on top of the correct-first PR 10 engine:
+
+- **Self-speculative decoding** (``spec_decode=k``): a truncated-stage
+  draft (:func:`~bluefog_tpu.parallel.compose.draft_carve` — the first
+  ``spec_stages`` stages of the target's own pipeline, early-exited into
+  the shared head) drafts ``k`` tokens in one fused scan, then ONE
+  target chunk call verifies all ``k`` causally and the host keeps the
+  longest agreeing prefix plus the target's bonus token.  Accepted
+  tokens are bit-identical to plain greedy decode (the accept rule only
+  ever emits target-argmax tokens), so speculation is pure throughput.
+- **Shared prefix pages** (``prefix_pages=p``): content-hashed prompt
+  prefixes are sealed once into reserved cache rows; a prefix-hit
+  request prefills only its divergent remainder (:meth:`chunk_prefill`)
+  and every attention reads through the page indirection.
+- **Quantized KV** (``kv_dtype="int8"|"fp8"``): pages stored with the
+  wire codec's per-(position, head) amax recipe, dequantized inside the
+  attend kernels; prefill's own dense attention stays full-precision —
+  drift only enters where a stored page is read back.
 """
 from __future__ import annotations
 
@@ -37,28 +58,64 @@ from jax import lax
 from jax.sharding import NamedSharding
 from jax.sharding import PartitionSpec as P
 
-from ..models.transformer import apply_rope, apply_rope_rows
+from ..models.transformer import apply_rope, apply_rope_grid, apply_rope_rows
 from ..ops.ulysses import dense_attention
-from ..parallel.compose import AXES, LMConfig, Mesh3D, _ln
+from ..parallel.compose import AXES, LMConfig, Mesh3D, _ln, draft_carve
 from ..utils import flight as _flight
 from ..utils import metrics as _metrics
 from . import kv_cache as _kv
 
 __all__ = ["ServeConfig", "ServeEngine"]
 
+_BUCKET_GRAMMAR = ("'<batch,...>@<prompt_len,...>' with positive ints "
+                   "(e.g. '1,2,4@8,16')")
+
 
 def _parse_buckets(spec: str) -> Tuple[Tuple[int, ...], Tuple[int, ...]]:
-    """``"1,2,4@8,16"`` -> ``((1, 2, 4), (8, 16))`` (batch@prefill)."""
-    try:
-        batch_s, _, prefill_s = spec.partition("@")
-        batch = tuple(int(t) for t in batch_s.split(",") if t.strip())
-        prefill = tuple(int(t) for t in prefill_s.split(",") if t.strip()) \
-            if prefill_s else ()
-    except ValueError as e:
+    """``"1,2,4@8,16"`` -> ``((1, 2, 4), (8, 16))`` (batch@prefill).
+
+    Malformed specs are rejected naming the offending token and the
+    expected grammar, so a typo'd env var fails loudly at config time
+    instead of as a bare ``int()`` traceback.
+    """
+    if spec.count("@") > 1:
         raise ValueError(
-            f"BLUEFOG_SERVE_BUCKETS={spec!r}: expected "
-            "'<batch,...>@<prompt_len,...>' (e.g. '1,2,4@8,16')") from e
-    return batch, prefill
+            f"BLUEFOG_SERVE_BUCKETS={spec!r}: more than one '@' — expected "
+            + _BUCKET_GRAMMAR)
+    batch_s, _, prefill_s = spec.partition("@")
+
+    def ints(part: str, side: str) -> Tuple[int, ...]:
+        out = []
+        for tok in part.split(","):
+            tok = tok.strip()
+            if not tok:
+                continue
+            try:
+                v = int(tok)
+            except ValueError:
+                raise ValueError(
+                    f"BLUEFOG_SERVE_BUCKETS={spec!r}: bad {side} bucket "
+                    f"token {tok!r} — expected " + _BUCKET_GRAMMAR) from None
+            if v < 1:
+                raise ValueError(
+                    f"BLUEFOG_SERVE_BUCKETS={spec!r}: {side} bucket "
+                    f"{tok!r} must be >= 1 — expected " + _BUCKET_GRAMMAR)
+            out.append(v)
+        return tuple(out)
+
+    return ints(batch_s, "batch"), ints(prefill_s, "prefill")
+
+
+def _env_int(name: str, tok: str, grammar: str) -> int:
+    try:
+        v = int(tok.strip())
+    except ValueError:
+        raise ValueError(f"{name}={tok!r}: bad token {tok.strip()!r} — "
+                         f"expected {grammar}") from None
+    if v < 0:
+        raise ValueError(f"{name}={tok!r}: {tok.strip()!r} must be >= 0 — "
+                         f"expected {grammar}")
+    return v
 
 
 @dataclasses.dataclass(frozen=True)
@@ -71,6 +128,19 @@ class ServeConfig:
     prompt pad lengths, same contract.  ``slots``/``max_len`` size each
     replica's KV cache; ``decode_steps_per_call`` fuses that many greedy
     tokens into one program call (admission only happens between calls).
+
+    Fast-path knobs (all default-off, so the default config compiles the
+    exact PR 10 programs):
+
+    - ``spec_decode``: draft depth k for self-speculative decoding (0 =
+      off); ``spec_stages`` is how many pipeline stages the draft runs.
+    - ``prefix_pages`` / ``prefix_page_tokens``: shared prefix pool size
+      and the page granularity prompts are content-hashed at.
+    - ``kv_dtype``: KV page storage — ``"raw"`` (engine dtype), or
+      ``"int8"`` / ``"fp8"`` via the wire-codec quantizer.
+    - ``temperature`` / ``top_p`` / ``seed``: the fused sampler.  0.0
+      temperature is exact greedy (the default); speculative decoding
+      requires greedy (its accept rule is argmax-prefix agreement).
     """
     batch_buckets: Tuple[int, ...] = (1, 2, 4)
     prefill_buckets: Tuple[int, ...] = (8, 16)
@@ -78,6 +148,14 @@ class ServeConfig:
     max_len: int = 64
     decode_steps_per_call: int = 1
     dtype: Any = jnp.float32
+    kv_dtype: str = "raw"
+    spec_decode: int = 0
+    spec_stages: int = 1
+    prefix_pages: int = 0
+    prefix_page_tokens: int = 16
+    temperature: float = 0.0
+    top_p: float = 1.0
+    seed: int = 0
 
     def __post_init__(self):
         if not self.batch_buckets or not self.prefill_buckets:
@@ -97,16 +175,86 @@ class ServeConfig:
                 f"exceeds max_len ({self.max_len})")
         if self.decode_steps_per_call < 1:
             raise ValueError("decode_steps_per_call must be >= 1")
+        if self.kv_dtype not in _kv.KV_STORES:
+            raise ValueError(f"kv_dtype={self.kv_dtype!r}: expected one of "
+                             f"{', '.join(_kv.KV_STORES)}")
+        _kv.store_dtype(self.kv_dtype)      # fp8 needs dtype support
+        if self.spec_decode < 0:
+            raise ValueError("spec_decode (draft depth k) must be >= 0")
+        if self.spec_stages < 1:
+            raise ValueError("spec_stages must be >= 1")
+        if self.prefix_pages < 0:
+            raise ValueError("prefix_pages must be >= 0")
+        if self.prefix_page_tokens < 1:
+            raise ValueError("prefix_page_tokens must be >= 1")
+        if self.prefix_pages and \
+                self.prefix_page_tokens > self.prefill_buckets[-1]:
+            raise ValueError(
+                f"prefix_page_tokens ({self.prefix_page_tokens}) exceeds "
+                f"the largest prefill bucket ({self.prefill_buckets[-1]}): "
+                "a prefix page is sealed by one prefill call")
+        if self.temperature < 0.0:
+            raise ValueError("temperature must be >= 0 (0 = greedy)")
+        if not 0.0 < self.top_p <= 1.0:
+            raise ValueError("top_p must be in (0, 1]")
+        if self.spec_decode and self.temperature > 0.0:
+            raise ValueError(
+                "speculative decoding is greedy-only: its accept rule is "
+                "argmax-prefix agreement; sampled speculation needs the "
+                "full accept-reject rule (set temperature=0.0 or "
+                "spec_decode=0)")
+
+    @property
+    def decode_window(self) -> int:
+        """Most tokens one engine call can add to a slot (plain fused
+        decode vs one speculative round's k drafts + bonus)."""
+        return max(self.decode_steps_per_call,
+                   self.spec_decode + 1 if self.spec_decode else 0)
 
     @classmethod
     def from_env(cls, **overrides) -> "ServeConfig":
-        """Honour ``BLUEFOG_SERVE_BUCKETS='<batch,...>@<prompt_len,...>'``."""
+        """Honour the serving fast-path env surface:
+
+        - ``BLUEFOG_SERVE_BUCKETS='<batch,...>@<prompt_len,...>'``
+        - ``BLUEFOG_SPEC_DECODE='<k>'`` or ``'<k>@<stages>'``
+        - ``BLUEFOG_KV_DTYPE='raw'|'int8'|'fp8'``
+        - ``BLUEFOG_PREFIX_PAGES='<pages>'`` or ``'<pages>x<page_tokens>'``
+        """
         spec = os.environ.get("BLUEFOG_SERVE_BUCKETS", "")
         if spec:
             batch, prefill = _parse_buckets(spec)
             overrides.setdefault("batch_buckets", batch)
             if prefill:
                 overrides.setdefault("prefill_buckets", prefill)
+        sd = os.environ.get("BLUEFOG_SPEC_DECODE", "")
+        if sd:
+            grammar = "'<k>' or '<k>@<stages>' (e.g. '4' or '4@1')"
+            k_s, _, st_s = sd.partition("@")
+            overrides.setdefault(
+                "spec_decode", _env_int("BLUEFOG_SPEC_DECODE", k_s, grammar))
+            if st_s:
+                overrides.setdefault(
+                    "spec_stages",
+                    _env_int("BLUEFOG_SPEC_DECODE", st_s, grammar))
+        kd = os.environ.get("BLUEFOG_KV_DTYPE", "")
+        if kd:
+            if kd not in _kv.KV_STORES:
+                raise ValueError(
+                    f"BLUEFOG_KV_DTYPE={kd!r}: bad token {kd!r} — expected "
+                    f"one of {', '.join(_kv.KV_STORES)}")
+            overrides.setdefault("kv_dtype", kd)
+        pp = os.environ.get("BLUEFOG_PREFIX_PAGES", "")
+        if pp:
+            grammar = ("'<pages>' or '<pages>x<page_tokens>' "
+                       "(e.g. '4' or '4x16')")
+            pages_s, _, ptok_s = pp.partition("x")
+            overrides.setdefault(
+                "prefix_pages",
+                _env_int("BLUEFOG_PREFIX_PAGES", pages_s, grammar))
+            if ptok_s:
+                overrides.setdefault(
+                    "prefix_page_tokens",
+                    _env_int("BLUEFOG_PREFIX_PAGES", ptok_s, grammar))
         return cls(**overrides)
 
     def batch_bucket_for(self, lanes: int) -> int:
@@ -146,10 +294,12 @@ class ServeEngine:
                 "no sequence to shard — fold sp into tp for inference")
         cfg.validate(m)
         scfg = scfg or ServeConfig.from_env()
-        if scfg.max_len < scfg.prefill_buckets[-1] + scfg.decode_steps_per_call:
+        if scfg.max_len < scfg.prefill_buckets[-1] + scfg.decode_window:
             raise ValueError("max_len leaves no room to decode past the "
                              "longest prompt bucket")
         self.m, self.cfg, self.scfg = m, cfg, scfg
+        self.draft = draft_carve(m, cfg, scfg.spec_stages) \
+            if scfg.spec_decode else None
         self._sharding = NamedSharding(m.mesh, P(AXES))
         # normalize through the SAME placement path update_params uses, so
         # a mid-traffic weight swap presents bit-identical shardings to the
@@ -158,20 +308,39 @@ class ServeEngine:
         self.cache_cfg = _kv.KVCacheConfig(
             layers=cfg.layers // m.pp, slots=scfg.slots,
             max_len=scfg.max_len, kv_heads=cfg.heads // m.tp,
-            head_dim=cfg.d_model // cfg.heads, dtype=scfg.dtype)
+            head_dim=cfg.d_model // cfg.heads, dtype=scfg.dtype,
+            store=scfg.kv_dtype, prefix_slots=scfg.prefix_pages)
         # materialize the zero cache THROUGH a shard_map so its sharding is
         # byte-identical to what the jitted bodies emit — a device_put'd
         # P(AXES) spec normalizes differently (size-1 axes dropped) and
         # would retrace every bucket once on its second visit
-        per_dev = (1, self.cache_cfg.layers, scfg.slots + 1, scfg.max_len,
-                   self.cache_cfg.kv_heads, self.cache_cfg.head_dim)
+        cc = self.cache_cfg
+        per_dev = (1, cc.layers, cc.rows, cc.max_len, cc.kv_heads,
+                   cc.head_dim)
+        pay_dt = _kv.store_dtype(cc.store, cc.dtype)
+
+        def _zeros():
+            cache = {"k": jnp.zeros(per_dev, pay_dt),
+                     "v": jnp.zeros(per_dev, pay_dt)}
+            if cc.quantized:
+                cache["k_scale"] = jnp.zeros(per_dev[:-1], jnp.float32)
+                cache["v_scale"] = jnp.zeros(per_dev[:-1], jnp.float32)
+            return cache
+
         self.cache = jax.jit(jax.shard_map(
-            lambda: {"k": jnp.zeros(per_dev, scfg.dtype),
-                     "v": jnp.zeros(per_dev, scfg.dtype)},
-            mesh=m.mesh, in_specs=(), out_specs=P(AXES)))()
+            _zeros, mesh=m.mesh, in_specs=(), out_specs=P(AXES)))()
         self._decode_jit = self._build(self._decode_body)
         self._prefill_jit = self._build(self._prefill_body)
-        self._warm_sizes: Optional[Tuple[int, int]] = None
+        self._chunk_jit = self._build(self._chunk_body) \
+            if (scfg.spec_decode or scfg.prefix_pages) else None
+        self._draft_jit = self._build(self._draft_body) \
+            if scfg.spec_decode else None
+        # per-(replica, physical row) raw PRNG keys for the fused sampler;
+        # re-seeded deterministically at each prefill from (seed, replica,
+        # slot, admission count), so a fixed seed replays a fixed run
+        self._slot_keys = np.zeros((m.dp, cc.rows, 2), np.uint32)
+        self._seed_count = 0
+        self._warm_sizes: Optional[Tuple[int, ...]] = None
 
     # ------------------------------------------------------------------
     # device-side bodies (per-device shapes, leading [1, ...] sliced off)
@@ -184,7 +353,37 @@ class ServeEngine:
                           check_vma=False),
             donate_argnums=(1,))
 
-    def _layer_step(self, lp, x, kl, vl, slot_ids, lens):
+    @property
+    def _use_prefix(self) -> bool:
+        return self.scfg.prefix_pages > 0
+
+    def _next_token(self, logits, keys):
+        """Greedy argmax, or the fused temperature/top-p sampler.
+
+        ``logits``: ``[S, V]``; ``keys``: ``[S, 2]`` raw per-lane PRNG
+        keys, split once per sampled token so the stream is deterministic
+        in (seed, lane history).  top-p keeps the smallest
+        probability-sorted set covering ``top_p`` mass (always >= 1
+        token) and renormalizes inside ``categorical``.
+        """
+        scfg = self.scfg
+        if scfg.temperature <= 0.0:
+            return jnp.argmax(logits, axis=-1), keys
+
+        def one(lg, key):
+            k_use, k_next = jax.random.split(key)
+            lg = lg / scfg.temperature
+            if scfg.top_p < 1.0:
+                srt = jnp.sort(lg)[::-1]
+                probs = jax.nn.softmax(srt)
+                keep = (jnp.cumsum(probs) - probs) < scfg.top_p
+                thresh = jnp.min(jnp.where(keep, srt, jnp.inf))
+                lg = jnp.where(lg >= thresh, lg, -jnp.inf)
+            return jax.random.categorical(k_use, lg), k_next
+
+        return jax.vmap(one)(logits, keys)
+
+    def _layer_step(self, lp, x, cl, slot_ids, lens, prows, plens):
         """One decoder block on one new token per lane: ``x`` is ``[S, D]``."""
         cfg, m = self.cfg, self.m
         Hl = cfg.heads // m.tp
@@ -195,66 +394,154 @@ class ServeEngine:
         q = apply_rope_rows(q.reshape(S, Hl, hsz), lens)
         k = apply_rope_rows(k.reshape(S, Hl, hsz), lens)
         v = v.reshape(S, Hl, hsz)
-        kl, vl = _kv.append_rows(kl, vl, slot_ids, lens, k, v)
-        att = _kv.attend_rows(q, kl, vl, slot_ids, lens)
+        cl = _kv.layer_append(cl, slot_ids, lens, k, v,
+                              store=self.scfg.kv_dtype)
+        att = _kv.attend_rows(q, cl["k"], cl["v"], slot_ids, lens,
+                              k_scale=cl.get("k_scale"),
+                              v_scale=cl.get("v_scale"),
+                              prefix_slots=prows, prefix_lens=plens)
         x = x + lax.psum(att.reshape(S, Hl * hsz) @ lp["wo"], "tp")
         h = _ln(x)
         x = x + lax.psum(jax.nn.gelu(h @ lp["w1"]) @ lp["w2"], "tp")
-        return x, kl, vl
+        return x, cl
 
-    def _pp_cycle(self, blocks, x, ck, cv, stage_apply):
-        """Cycle ``x`` through all pipeline stages; each stage's layer scan
-        runs everywhere but only the owning stage keeps its activation and
-        cache writes, so the program is identical on every device."""
+    def _pp_cycle(self, blocks, x, cache, stage_apply, n_stages=None):
+        """Cycle ``x`` through ``n_stages`` pipeline stages (all of them by
+        default; the draft truncates); each stage's layer scan runs
+        everywhere but only the owning stage keeps its activation and
+        cache writes, so the program is identical on every device.  After
+        n hops the valid activation sits at stage ``n % pp`` (0 for the
+        full cycle) — the caller reads logits there and ``psum``
+        broadcasts them."""
+        n = self.m.pp if n_stages is None else n_stages
         sid = lax.axis_index("stage")
-        for s in range(self.m.pp):
-            y, nk, nv = stage_apply(blocks, x, ck, cv)
+        for s in range(n):
+            y, nc = stage_apply(blocks, x, cache)
             keep = sid == s
             x = jnp.where(keep, y, x)
-            ck = jnp.where(keep, nk, ck)
-            cv = jnp.where(keep, nv, cv)
+            cache = jax.tree.map(
+                lambda new, old: jnp.where(keep, new, old), nc, cache)
             x = lax.ppermute(
                 x, "stage",
                 [(i, (i + 1) % self.m.pp) for i in range(self.m.pp)])
-        # pp hops return the last stage's output to stage 0, which alone
-        # holds the valid final activation — psum broadcasts its logits
-        return x, ck, cv, sid
+        return x, cache, sid
 
-    def _decode_body(self, params, cache, toks, slot_ids, lens):
-        params, cache, toks, slot_ids, lens = jax.tree.map(
-            lambda t: t[0], (params, cache, toks, slot_ids, lens))
+    def _decode_scan(self, params, cache, toks, slot_ids, lens, prows,
+                     plens, keys, *, steps, n_stages=None):
+        """The shared fused-decode scan: ``steps`` tokens, optionally on a
+        truncated (draft) stage cycle.  Returns ``(gen [steps, S], keys,
+        cache)``."""
         embed = params["shared"]["embed"]
         head = params["shared"]["head"]
         bp = params["blocks"]
+        out_stage = (self.m.pp if n_stages is None else n_stages) % self.m.pp
 
         def step(carry, _):
-            toks, lens, ck, cv = carry
+            toks, lens, cache, keys = carry
 
-            def stage_apply(blocks, x, ck, cv):
+            def stage_apply(blocks, x, c):
                 def one(x, xs):
-                    lp, kl, vl = xs
-                    x, kl, vl = self._layer_step(lp, x, kl, vl, slot_ids,
-                                                 lens)
-                    return x, (kl, vl)
-                x, (nk, nv) = lax.scan(one, x, (blocks, ck, cv))
-                return x, nk, nv
+                    lp, cl = xs
+                    x, cl = self._layer_step(lp, x, cl, slot_ids, lens,
+                                             prows, plens)
+                    return x, cl
+                return lax.scan(one, x, (blocks, c))
 
             x = embed[toks]                                   # [S, D]
-            x, ck, cv, sid = self._pp_cycle(bp, x, ck, cv, stage_apply)
+            x, cache, sid = self._pp_cycle(bp, x, cache, stage_apply,
+                                           n_stages=n_stages)
             logits = lax.psum(
-                jnp.where(sid == 0, _ln(x) @ head, 0.0), "stage")
-            nxt = jnp.argmax(logits, axis=-1).astype(toks.dtype)
-            return (nxt, lens + 1, ck, cv), nxt
+                jnp.where(sid == out_stage, _ln(x) @ head, 0.0), "stage")
+            if n_stages is None:
+                nxt, keys = self._next_token(logits, keys)
+            else:
+                nxt = jnp.argmax(logits, axis=-1)     # draft: greedy only
+            nxt = nxt.astype(toks.dtype)
+            return (nxt, lens + 1, cache, keys), nxt
 
-        (_, _, ck, cv), gen = lax.scan(
-            step, (toks, lens, cache["k"], cache["v"]), None,
-            length=self.scfg.decode_steps_per_call)
-        return jax.tree.map(lambda t: t[None],
-                            (gen, {"k": ck, "v": cv}))
+        (_, _, cache, keys), gen = lax.scan(
+            step, (toks, lens, cache, keys), None, length=steps)
+        return gen, keys, cache
+
+    def _split_args(self, args):
+        return jax.tree.map(lambda t: t[0], args)
+
+    def _decode_body(self, params, cache, toks, slot_ids, lens, prows,
+                     plens, keys):
+        params, cache, toks, slot_ids, lens, prows, plens, keys = \
+            self._split_args((params, cache, toks, slot_ids, lens, prows,
+                              plens, keys))
+        gen, keys, cache = self._decode_scan(
+            params, cache, toks, slot_ids, lens, prows, plens, keys,
+            steps=self.scfg.decode_steps_per_call)
+        return jax.tree.map(lambda t: t[None], (gen, keys, cache))
+
+    def _draft_body(self, params, cache, toks, slot_ids, lens, prows,
+                    plens):
+        """k greedy draft tokens on the truncated stage cycle.  The draft
+        IS the target's own first ``spec_stages`` stages, so its
+        early-layer cache appends equal what the verify pass will write
+        over them — shared rows stay consistent by construction."""
+        params, cache, toks, slot_ids, lens, prows, plens = \
+            self._split_args((params, cache, toks, slot_ids, lens, prows,
+                              plens))
+        keys = jnp.zeros(toks.shape + (2,), jnp.uint32)   # greedy: unused
+        gen, _, cache = self._decode_scan(
+            params, cache, toks, slot_ids, lens, prows, plens, keys,
+            steps=self.scfg.spec_decode, n_stages=self.draft.stages)
+        return jax.tree.map(lambda t: t[None], (gen, cache))
+
+    def _chunk_body(self, params, cache, toks, slot_ids, lens, prows,
+                    plens):
+        """The k-token verify forward / chunked prefill: ``toks`` is
+        ``[S, T]`` with token t of lane i at position ``lens[i] + t``.
+        Appends all T kv rows then attends causally over the slot (and
+        through the prefix indirection); emits the argmax at EVERY
+        position ``[S, T]`` — for the verify these are the target tokens
+        g_1..g_T, for a chunked prefill position ``true_len - 1`` is the
+        request's first generated token."""
+        params, cache, toks, slot_ids, lens, prows, plens = \
+            self._split_args((params, cache, toks, slot_ids, lens, prows,
+                              plens))
+        cfg, m = self.cfg, self.m
+        Hl = cfg.heads // m.tp
+        hsz = cfg.d_model // cfg.heads
+        S, T = toks.shape
+        pos = lens[:, None] + jnp.arange(T)[None, :]          # [S, T]
+
+        def stage_apply(blocks, x, c):
+            def one(x, xs):
+                lp, cl = xs
+                h = _ln(x)
+                q, k, v = jnp.split(h @ lp["wqkv"], 3, axis=-1)
+                q = apply_rope_grid(q.reshape(S, T, Hl, hsz), pos)
+                k = apply_rope_grid(k.reshape(S, T, Hl, hsz), pos)
+                v = v.reshape(S, T, Hl, hsz)
+                cl = _kv.layer_append_chunk(cl, slot_ids, lens, k, v,
+                                            store=self.scfg.kv_dtype)
+                att = _kv.attend_chunk(q, cl, slot_ids, lens,
+                                       prefix_slots=prows,
+                                       prefix_lens=plens)
+                x = x + lax.psum(
+                    att.reshape(S, T, Hl * hsz) @ lp["wo"], "tp")
+                h = _ln(x)
+                x = x + lax.psum(
+                    jax.nn.gelu(h @ lp["w1"]) @ lp["w2"], "tp")
+                return x, cl
+            return lax.scan(one, x, (blocks, c))
+
+        x = params["shared"]["embed"][toks]                   # [S, T, D]
+        x, cache, sid = self._pp_cycle(params["blocks"], x, cache,
+                                       stage_apply)
+        logits = lax.psum(
+            jnp.where(sid == 0, _ln(x) @ params["shared"]["head"], 0.0),
+            "stage")                                          # [S, T, V]
+        gen = jnp.argmax(logits, axis=-1).astype(toks.dtype)
+        return jax.tree.map(lambda t: t[None], (gen, cache))
 
     def _prefill_body(self, params, cache, toks, slot_id, true_len):
-        params, cache, toks, slot_id, true_len = jax.tree.map(
-            lambda t: t[0], (params, cache, toks, slot_id, true_len))
+        params, cache, toks, slot_id, true_len = \
+            self._split_args((params, cache, toks, slot_id, true_len))
         cfg, m = self.cfg, self.m
         Hl = cfg.heads // m.tp
         hsz = cfg.d_model // cfg.heads
@@ -262,9 +549,9 @@ class ServeEngine:
         positions = jnp.arange(Tpad)
         x = params["shared"]["embed"][toks][None]             # [1, Tpad, D]
 
-        def stage_apply(blocks, x, ck, cv):
+        def stage_apply(blocks, x, c):
             def one(x, xs):
-                lp, kl, vl = xs
+                lp, cl = xs
                 h = _ln(x)
                 q, k, v = jnp.split(h @ lp["wqkv"], 3, axis=-1)
                 q = apply_rope(q.reshape(1, Tpad, Hl, hsz), positions)
@@ -272,30 +559,28 @@ class ServeEngine:
                 v = v.reshape(1, Tpad, Hl, hsz)
                 # the whole padded prompt lands in the slot; positions past
                 # true_len hold garbage that decode's length mask never
-                # reads before the append overwrites it
-                kl = lax.dynamic_update_slice(
-                    kl, k[0][None].astype(kl.dtype), (slot_id, 0, 0, 0))
-                vl = lax.dynamic_update_slice(
-                    vl, v[0][None].astype(vl.dtype), (slot_id, 0, 0, 0))
+                # reads before the append overwrites it.  Attention over
+                # the prompt itself is dense full-precision — quantization
+                # drift only enters where a STORED page is read back
+                cl = _kv.layer_prefill(cl, slot_id, k[0], v[0],
+                                       store=self.scfg.kv_dtype)
                 att = dense_attention(q, k, v, causal=True)
                 x = x + lax.psum(
                     att.reshape(1, Tpad, Hl * hsz) @ lp["wo"], "tp")
                 h = _ln(x)
                 x = x + lax.psum(
                     jax.nn.gelu(h @ lp["w1"]) @ lp["w2"], "tp")
-                return x, (kl, vl)
-            x, (nk, nv) = lax.scan(one, x, (blocks, ck, cv))
-            return x, nk, nv
+                return x, cl
+            return lax.scan(one, x, (blocks, c))
 
-        x, ck, cv, sid = self._pp_cycle(params["blocks"], x,
-                                        cache["k"], cache["v"], stage_apply)
+        x, cache, sid = self._pp_cycle(params["blocks"], x, cache,
+                                       stage_apply)
         logits = jnp.where(sid == 0, _ln(x[0]) @ params["shared"]["head"],
                            0.0)                               # [Tpad, V]
         logits = lax.psum(logits, "stage")
         last = lax.dynamic_slice_in_dim(logits, true_len - 1, 1, axis=0)[0]
         nxt = jnp.argmax(last, axis=-1).astype(toks.dtype)
-        return jax.tree.map(lambda t: t[None],
-                            (nxt, last, {"k": ck, "v": cv}))
+        return jax.tree.map(lambda t: t[None], (nxt, last, cache))
 
     # ------------------------------------------------------------------
     # host-side surface (per-REPLICA shapes; the engine broadcasts each
@@ -316,22 +601,77 @@ class ServeEngine:
         """``[n_devices, ...]`` -> ``[replicas, ...]`` (slice rows agree)."""
         return np.asarray(out)[::self.m.slice_size]
 
+    def _seed_slot(self, replica: int, slot: int) -> None:
+        """Deterministic per-admission PRNG key for the fused sampler."""
+        self._seed_count += 1
+        key = jax.random.fold_in(
+            jax.random.fold_in(jax.random.PRNGKey(self.scfg.seed),
+                               replica * self.cache_cfg.rows + slot),
+            self._seed_count)
+        self._slot_keys[replica, slot] = np.asarray(
+            jax.random.key_data(key), np.uint32)
+
+    def _trash_vec(self, S: int) -> np.ndarray:
+        return np.full((self.m.dp, S), self.cache_cfg.trash_slot, np.int32)
+
+    def _prefix_args(self, prefix_rows, prefix_lens, S: int):
+        """Normalize optional per-lane prefix attachments to arrays (trash
+        row at length 0 = no indirection for that lane)."""
+        if not self._use_prefix:
+            if prefix_rows is not None:
+                raise ValueError("prefix attachments need prefix_pages > 0")
+            return None, None
+        if prefix_rows is None:
+            return self._trash_vec(S), np.zeros((self.m.dp, S), np.int32)
+        return (np.asarray(prefix_rows, np.int32),
+                np.asarray(prefix_lens, np.int32))
+
+    def _gather_keys(self, slots: np.ndarray) -> np.ndarray:
+        return np.take_along_axis(
+            self._slot_keys, np.asarray(slots, np.int64)[..., None], axis=1)
+
+    def _scatter_keys(self, slots: np.ndarray, keys: np.ndarray) -> None:
+        np.put_along_axis(self._slot_keys,
+                          np.asarray(slots, np.int64)[..., None],
+                          keys, axis=1)
+
     def prefill(self, replica: int, slot: int,
                 tokens: Sequence[int]) -> Tuple[int, np.ndarray]:
         """Prefill one request into ``slot`` of ``replica``; other replicas
         run the same program against their trash slot.  Returns the first
         greedy token and the last-position logits ``[vocab]``."""
-        scfg = self.scfg
-        if not 0 <= slot < scfg.slots:
-            raise ValueError(f"slot {slot} out of range [0, {scfg.slots})")
+        if not 0 <= slot < self.scfg.slots:
+            raise ValueError(f"slot {slot} out of range "
+                             f"[0, {self.scfg.slots})")
+        nxt, logits = self._prefill_into(replica, slot, tokens)
+        self._seed_slot(replica, slot)
+        return nxt, logits
+
+    def seal_prefix(self, replica: int, row: int,
+                    tokens: Sequence[int]) -> None:
+        """Prefill a shared prefix into reserved page row ``row`` — the
+        same compiled prefill program (the row id is data, not shape), so
+        sealing never retraces.  The row must come from the replica's
+        :class:`~bluefog_tpu.serve.kv_cache.PrefixCache` ``admit``."""
+        cc = self.cache_cfg
+        if not cc.slots <= row < cc.slots + cc.prefix_slots:
+            raise ValueError(f"prefix row {row} out of range "
+                             f"[{cc.slots}, {cc.slots + cc.prefix_slots})")
+        if len(tokens) % self.scfg.prefix_page_tokens:
+            raise ValueError(f"prefix of {len(tokens)} tokens is not whole "
+                             f"pages of {self.scfg.prefix_page_tokens}")
+        self._prefill_into(replica, row, tokens)
+
+    def _prefill_into(self, replica: int, row: int,
+                      tokens: Sequence[int]) -> Tuple[int, np.ndarray]:
         if not tokens:
             raise ValueError("empty prompt")
-        Tpad = scfg.prefill_bucket_for(len(tokens))
+        Tpad = self.scfg.prefill_bucket_for(len(tokens))
         R = self.m.dp
         toks = np.zeros((R, Tpad), np.int32)
         toks[replica, :len(tokens)] = np.asarray(tokens, np.int32)
-        slot_id = np.full((R,), self.cache_cfg.trash_slot, np.int32)
-        slot_id[replica] = slot
+        slot_id = self._trash_vec(1)[:, 0]
+        slot_id[replica] = row
         true_len = np.ones((R,), np.int32)
         true_len[replica] = len(tokens)
         nxt, logits, self.cache = self._prefill_jit(
@@ -341,27 +681,151 @@ class ServeEngine:
         return (int(self._collect(nxt)[replica]),
                 self._collect(logits)[replica])
 
+    def chunk_prefill(self, replica: int, slot: int, tokens: Sequence[int],
+                      start: int, prefix_row: int) -> int:
+        """Prefill only the divergent remainder of a prefix-hit request.
+
+        The request attached to a sealed prefix of ``start`` tokens at
+        page row ``prefix_row``; ``tokens`` is the rest of its prompt
+        (``>= 1`` — the page granularity guarantees a leftover token).
+        The remainder chunk attends through the page indirection, writes
+        its own kv into the private ``slot`` (positions ``start ..``),
+        and returns the request's first greedy token.  Cost is one chunk
+        of ``len(tokens)`` instead of the whole prompt — the TTFT win
+        serve_bench measures.
+        """
+        if not 0 <= slot < self.scfg.slots:
+            raise ValueError(f"slot {slot} out of range "
+                             f"[0, {self.scfg.slots})")
+        if not tokens:
+            raise ValueError("empty remainder: a prefix hit always leaves "
+                             ">= 1 prompt token")
+        Tpad = self.scfg.prefill_bucket_for(len(tokens))
+        R = self.m.dp
+        toks = np.zeros((R, 1, Tpad), np.int32)
+        toks[replica, 0, :len(tokens)] = np.asarray(tokens, np.int32)
+        slots = self._trash_vec(1)
+        slots[replica, 0] = slot
+        lens = np.zeros((R, 1), np.int32)
+        lens[replica, 0] = start
+        prows = self._trash_vec(1)
+        prows[replica, 0] = prefix_row
+        plens = np.zeros((R, 1), np.int32)
+        plens[replica, 0] = start
+        gen = self._chunk_call(toks, slots, lens, prows, plens)
+        self._seed_slot(replica, slot)
+        return int(gen[replica, 0, len(tokens) - 1])
+
+    def _chunk_call(self, toks, slots, lens, prows, plens) -> np.ndarray:
+        prows, plens = self._prefix_args(prows, plens, toks.shape[1])
+        gen, self.cache = self._chunk_jit(
+            self.params, self.cache,
+            self._expand(np.asarray(toks, np.int32)),
+            self._expand(np.asarray(slots, np.int32)),
+            self._expand(np.asarray(lens, np.int32)),
+            self._expand(prows) if prows is not None else None,
+            self._expand(plens) if plens is not None else None)
+        self._check_retrace(f"chunk S={toks.shape[1]} T={toks.shape[2]}")
+        return self._collect(gen)
+
     def decode(self, tokens: np.ndarray, slots: np.ndarray,
-               lens: np.ndarray) -> np.ndarray:
+               lens: np.ndarray, prefix_rows: Optional[np.ndarray] = None,
+               prefix_lens: Optional[np.ndarray] = None) -> np.ndarray:
         """One fused decode call for every replica at one batch bucket.
 
         ``tokens``/``slots``/``lens``: ``[replicas, S]`` with ``S`` in
         ``batch_buckets``; idle lanes use the trash slot with ``lens=0``.
         ``lens[r, i]`` is the position the lane's pending token occupies
-        (prompt length + tokens already generated).  Returns the greedy
-        tokens ``[replicas, decode_steps_per_call, S]``.
+        (prompt length + tokens already generated).  ``prefix_rows`` /
+        ``prefix_lens`` attach lanes to sealed prefix pages (trash row at
+        length 0 for unattached lanes).  Returns the decoded tokens
+        ``[replicas, decode_steps_per_call, S]`` (greedy, or sampled when
+        ``temperature > 0`` — each lane's PRNG stream was seeded at its
+        prefill).
         """
         S = np.asarray(tokens).shape[1]
         if S not in self.scfg.batch_buckets:
             raise ValueError(f"batch lane count {S} is not a declared "
                              f"bucket {self.scfg.batch_buckets}")
-        gen, self.cache = self._decode_jit(
+        slots = np.asarray(slots, np.int32)
+        prows, plens = self._prefix_args(prefix_rows, prefix_lens, S)
+        keys = self._gather_keys(slots)
+        gen, keys, self.cache = self._decode_jit(
             self.params, self.cache,
             self._expand(np.asarray(tokens, np.int32)),
-            self._expand(np.asarray(slots, np.int32)),
-            self._expand(np.asarray(lens, np.int32)))
+            self._expand(slots),
+            self._expand(np.asarray(lens, np.int32)),
+            self._expand(prows) if prows is not None else None,
+            self._expand(plens) if plens is not None else None,
+            self._expand(keys))
+        self._scatter_keys(slots, self._collect(keys))
         self._check_retrace(f"decode S={S}")
         return self._collect(gen)
+
+    def spec_decode(self, tokens: np.ndarray, slots: np.ndarray,
+                    lens: np.ndarray,
+                    prefix_rows: Optional[np.ndarray] = None,
+                    prefix_lens: Optional[np.ndarray] = None
+                    ) -> Tuple[np.ndarray, np.ndarray]:
+        """One speculative round: draft k, verify in one chunk, accept.
+
+        Same lane contract as :meth:`decode`.  Returns ``(emitted,
+        counts)``: ``emitted`` is ``[replicas, S, k+1]`` int32 (positions
+        ``>= counts`` hold -1), ``counts`` is ``[replicas, S]`` — each
+        lane advances by ``counts[r, i]`` tokens (``1 <= counts <= k+1``:
+        the accepted draft prefix plus the target's bonus token).  Every
+        emitted token is a target-argmax token, so the stream is
+        bit-identical to plain greedy decode; speculation only changes
+        how many arrive per call.
+        """
+        k = self.scfg.spec_decode
+        if not k:
+            raise ValueError("spec_decode is not armed "
+                             "(ServeConfig.spec_decode == 0)")
+        tokens = np.asarray(tokens, np.int32)
+        slots = np.asarray(slots, np.int32)
+        lens = np.asarray(lens, np.int32)
+        S = tokens.shape[1]
+        if S not in self.scfg.batch_buckets:
+            raise ValueError(f"batch lane count {S} is not a declared "
+                             f"bucket {self.scfg.batch_buckets}")
+        prows, plens = self._prefix_args(prefix_rows, prefix_lens, S)
+        drafts, self.cache = self._draft_jit(
+            self.params, self.cache, self._expand(tokens),
+            self._expand(slots), self._expand(lens),
+            self._expand(prows) if prows is not None else None,
+            self._expand(plens) if plens is not None else None)
+        self._check_retrace(f"draft S={S}")
+        drafts = self._collect(drafts)                  # [R, k, S]
+        d = np.transpose(drafts, (0, 2, 1))             # [R, S, k]
+        # verify chunk: [t0, d_1 .. d_k] per lane — the draft rows it
+        # appended are overwritten with the (identical) target values and
+        # the later-stage layers get theirs written for the first time
+        chunk = np.concatenate([tokens[:, :, None], d], axis=2)
+        gen = self._chunk_call(chunk, slots, lens, prows, plens)  # [R,S,k+1]
+        # accept: longest prefix where draft_i == target g_i, then the
+        # bonus g_{j+1}; rejected rows above the new frontier are garbage
+        # that the next round's appends overwrite before any read
+        match = d == gen[:, :, :k]
+        j = np.argmin(np.concatenate(
+            [match, np.zeros_like(match[:, :, :1])], axis=2), axis=2)
+        counts = (j + 1).astype(np.int32)
+        t_idx = np.arange(k + 1)[None, None, :]
+        d_pad = np.concatenate([d, np.zeros_like(d[:, :, :1])], axis=2)
+        emitted = np.where(
+            t_idx < j[:, :, None], d_pad,
+            np.where(t_idx == j[:, :, None], gen, -1)).astype(np.int32)
+        live = slots < self.scfg.slots                  # trash lanes don't count
+        drafted = int(live.sum()) * k
+        accepted = int(j[live].sum())
+        if drafted:
+            _metrics.counter(
+                "bluefog_serve_spec_drafted_total",
+                "draft tokens proposed by speculative decoding").inc(drafted)
+            _metrics.counter(
+                "bluefog_serve_spec_accepted_total",
+                "draft tokens accepted by the verify pass").inc(accepted)
+        return emitted, counts
 
     def idle_lane(self) -> Tuple[int, int, int]:
         """(token, slot, len) triple a padding lane should carry."""
@@ -374,27 +838,44 @@ class ServeEngine:
             lambda x: jax.device_put(jnp.asarray(x), self._sharding), params)
 
     def warmup(self) -> None:
-        """Compile every declared bucket, then arm the retrace sentinel."""
-        for Tpad in self.scfg.prefill_buckets:
+        """Compile every declared shape — prefill and decode buckets, and
+        when armed the draft/verify pair per decode bucket and the chunked
+        prefill per prefill bucket — then arm the retrace sentinel."""
+        scfg = self.scfg
+        for Tpad in scfg.prefill_buckets:
             self.prefill(0, 0, [0] * Tpad)
         tok, slot, ln = self.idle_lane()
-        for S in self.scfg.batch_buckets:
-            R = self.m.dp
-            self.decode(np.full((R, S), tok, np.int32),
-                        np.full((R, S), slot, np.int32),
-                        np.full((R, S), ln, np.int32))
-        self._warm_sizes = (self._decode_jit._cache_size(),
-                            self._prefill_jit._cache_size())
+        R = self.m.dp
+        for S in scfg.batch_buckets:
+            full = lambda v: np.full((R, S), v, np.int32)
+            self.decode(full(tok), full(slot), full(ln))
+            if scfg.spec_decode:
+                self.spec_decode(full(tok), full(slot), full(ln))
+        if self._use_prefix:
+            for Tpad in scfg.prefill_buckets:
+                toks = np.zeros((R, 1, Tpad), np.int32)
+                self._chunk_call(toks, self._trash_vec(1),
+                                 np.zeros((R, 1), np.int32),
+                                 self._trash_vec(1),
+                                 np.zeros((R, 1), np.int32))
+        self._warm_sizes = self._jit_sizes()
         _flight.record("serve", name="warmup",
-                       batch_buckets=list(self.scfg.batch_buckets),
-                       prefill_buckets=list(self.scfg.prefill_buckets))
+                       batch_buckets=list(scfg.batch_buckets),
+                       prefill_buckets=list(scfg.prefill_buckets),
+                       spec_decode=scfg.spec_decode,
+                       prefix_pages=scfg.prefix_pages,
+                       kv_dtype=scfg.kv_dtype)
         _metrics.mark_steady_state(True)
+
+    def _jit_sizes(self) -> Tuple[int, ...]:
+        return tuple(j._cache_size() if j is not None else 0
+                     for j in (self._decode_jit, self._prefill_jit,
+                               self._chunk_jit, self._draft_jit))
 
     def _check_retrace(self, detail: str) -> None:
         if self._warm_sizes is None:
             return
-        sizes = (self._decode_jit._cache_size(),
-                 self._prefill_jit._cache_size())
+        sizes = self._jit_sizes()
         if sizes > self._warm_sizes:
             _metrics.note_retrace(detail=f"serve engine {detail}")
             self._warm_sizes = sizes

@@ -3,15 +3,20 @@
 Layout (per device, i.e. per (replica, stage, tp) coordinate of the
 compose carving)::
 
-    k, v: [layers, slots + 1, max_len, kv_heads, head_dim]
+    k, v: [layers, slots + prefix_slots + 1, max_len, kv_heads, head_dim]
 
 * ``layers``   — the decoder blocks THIS pipeline stage owns;
 * ``slots``    — request slots: one resident sequence each, allocated at
   admission and recycled at retirement (continuous batching never reshapes
   the cache — shapes are static so the decode program never retraces);
-* slot ``slots`` (the last physical row) is the **trash slot**: padding
-  rows of a bucketed decode batch append their garbage kv there, so an
-  inactive lane can run the exact same program as a live one;
+* the next ``prefix_slots`` physical rows are **shared prefix pages**:
+  content-addressed prompt prefixes sealed once by a prefill and then
+  attached to by any number of requests (read-only after sealing — the
+  divergent suffix copy-on-writes into the request's private slot, so
+  sharers can never contaminate each other);
+* the last physical row is the **trash slot**: padding rows of a bucketed
+  decode batch append their garbage kv there, so an inactive lane can run
+  the exact same program as a live one;
 * ``max_len``  — per-slot token capacity (prompt + generated);
 * ``kv_heads`` — the kv heads THIS tp rank holds: the cache is sharded
   over ``("tp",)`` by splitting heads, and the layout is grouped-query
@@ -20,35 +25,103 @@ compose carving)::
   :class:`bluefog_tpu.models.transformer.RingTransformerBlock` — q heads
   attend their ``h // group`` kv head).
 
-The pure functions here (:func:`append_rows`, :func:`attend_rows`) are the
-single-device math the engine's shard_map body calls per layer; they are
-also unit-tested directly (GQA grouping, slot-reuse equivalence after
-evict).  :class:`SlotAllocator` is the host-side free list with occupancy
-gauges (``bluefog_serve_kv_slots_in_use`` / ``bluefog_serve_kv_occupancy``).
+**Quantized storage** (``store="int8"`` / ``"fp8"``): pages hold the
+quantized payload plus per-(position, head) f32 amax scales in sibling
+``k_scale``/``v_scale`` arrays — the exact symmetric-quantization recipe
+the gossip wire codec uses (:func:`bluefog_tpu.ops.collectives._amax_scale`
+with a head_dim-sized block), dequantized inside :func:`attend_rows` /
+:func:`attend_chunk` right before the score matmul.  ``store="raw"``
+keeps the payload in ``dtype`` (f32 or bf16) with no scales.
+
+The pure functions here (:func:`layer_append`, :func:`attend_rows`,
+:func:`attend_chunk`, ...) are the single-device math the engine's
+shard_map body calls per layer; they are also unit-tested directly (GQA
+grouping, slot-reuse equivalence after evict, quantization drift bounds).
+:class:`SlotAllocator` is the host-side free heap with occupancy gauges
+(``bluefog_serve_kv_slots_in_use`` / ``bluefog_serve_kv_occupancy``);
+:class:`PrefixCache` is the host-side content-addressed page directory
+(``bluefog_serve_prefix_{hits,misses}_total``).
 """
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Optional
+import hashlib
+import heapq
+from typing import Any, Dict, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 
+from ..ops.collectives import _amax_scale
 from ..utils import metrics as _metrics
 
 __all__ = ["KVCacheConfig", "init_cache", "append_rows", "attend_rows",
-           "SlotAllocator"]
+           "attend_chunk", "layer_append", "layer_append_chunk",
+           "layer_prefill", "quantize_rows", "dequantize_rows",
+           "store_dtype", "SlotAllocator", "PrefixCache"]
+
+KV_STORES = ("raw", "int8", "fp8")
+
+
+def store_dtype(store: str, raw_dtype: Any = jnp.float32):
+    """Payload dtype of one cache page under ``store``."""
+    if store == "raw":
+        return raw_dtype
+    if store == "int8":
+        return jnp.int8
+    if store == "fp8":
+        if not hasattr(jnp, "float8_e4m3fn"):
+            raise ValueError("fp8 KV needs jnp.float8_e4m3fn support in "
+                             "this jax build — use kv store 'int8'")
+        return jnp.float8_e4m3fn
+    raise ValueError(f"unknown KV store {store!r}: choose from {KV_STORES}")
+
+
+def quantize_rows(x: jax.Array, store: str):
+    """Quantize kv rows ``[..., head_dim]`` for page storage.
+
+    Returns ``(payload, scale)`` where ``scale`` is ``None`` for raw
+    storage and ``[...]`` (head_dim folded away) f32 otherwise — one amax
+    scale per (token position, kv head), i.e. the wire codec's ``@B``
+    blockwise recipe at ``B = head_dim``, reusing its
+    :func:`~bluefog_tpu.ops.collectives._amax_scale` kernel verbatim.
+    """
+    if store == "raw":
+        return x, None
+    shape = x.shape
+    xf = x.astype(jnp.float32).reshape(-1, shape[-1])
+    if store == "int8":
+        scaled, scale = _amax_scale(xf, 127.0, shape[-1])
+        q = jnp.clip(jnp.round(scaled), -127, 127).astype(jnp.int8)
+    elif store == "fp8":
+        f8max = float(jnp.finfo(store_dtype("fp8")).max)          # 448
+        scaled, scale = _amax_scale(xf, f8max, shape[-1])
+        q = scaled.astype(store_dtype("fp8"))
+    else:
+        raise ValueError(f"unknown KV store {store!r}: choose from "
+                         f"{KV_STORES}")
+    return q.reshape(shape), scale.reshape(shape[:-1])
+
+
+def dequantize_rows(q: jax.Array, scale: Optional[jax.Array],
+                    dtype: Any) -> jax.Array:
+    """Inverse of :func:`quantize_rows` (identity cast for raw storage)."""
+    if scale is None:
+        return q.astype(dtype)
+    return (q.astype(jnp.float32) * scale[..., None]).astype(dtype)
 
 
 @dataclasses.dataclass(frozen=True)
 class KVCacheConfig:
     """Static shape of one device's cache (all sharding already applied)."""
     layers: int            # decoder blocks on this pipeline stage
-    slots: int             # request slots (excluding the trash slot)
+    slots: int             # request slots (excluding prefix pages + trash)
     max_len: int           # tokens per slot
     kv_heads: int          # kv heads on this tp rank (GQA-compact)
     head_dim: int
-    dtype: Any = jnp.float32
+    dtype: Any = jnp.float32   # raw payload / dequantization target dtype
+    store: str = "raw"         # page storage: "raw" | "int8" | "fp8"
+    prefix_slots: int = 0      # shared prefix pages (rows after `slots`)
 
     def __post_init__(self):
         for name in ("layers", "slots", "max_len", "kv_heads", "head_dim"):
@@ -56,31 +129,71 @@ class KVCacheConfig:
             if not isinstance(v, int) or v < 1:
                 raise ValueError(f"KVCacheConfig.{name}={v!r} must be a "
                                  "positive int")
+        if not isinstance(self.prefix_slots, int) or self.prefix_slots < 0:
+            raise ValueError(f"KVCacheConfig.prefix_slots="
+                             f"{self.prefix_slots!r} must be an int >= 0")
+        store_dtype(self.store)        # validates the store name eagerly
+
+    @property
+    def rows(self) -> int:
+        """Physical rows: request slots + prefix pages + the trash slot."""
+        return self.slots + self.prefix_slots + 1
 
     @property
     def trash_slot(self) -> int:
         """Physical row index padding lanes write their garbage kv to."""
-        return self.slots
+        return self.slots + self.prefix_slots
+
+    def prefix_row(self, page: int) -> int:
+        """Physical row of shared prefix page ``page``."""
+        if not 0 <= page < self.prefix_slots:
+            raise ValueError(f"prefix page {page} out of range "
+                             f"[0, {self.prefix_slots})")
+        return self.slots + page
+
+    @property
+    def quantized(self) -> bool:
+        return self.store != "raw"
 
     def bytes(self) -> int:
-        """Device bytes of one (k, v) pair at this config."""
-        per = (self.layers * (self.slots + 1) * self.max_len
-               * self.kv_heads * self.head_dim)
-        return 2 * per * jnp.dtype(self.dtype).itemsize
+        """Device bytes of one cache (payload pages + riding scales)."""
+        per = self.layers * self.rows * self.max_len * self.kv_heads
+        payload = 2 * per * self.head_dim * \
+            jnp.dtype(store_dtype(self.store, self.dtype)).itemsize
+        scales = 2 * per * 4 if self.quantized else 0
+        return payload + scales
+
+    def bytes_per_token(self) -> int:
+        """Device bytes one cached token costs (k + v + scales), the
+        serve_bench ``kv_bytes_per_token`` row's per-device term."""
+        per_head = self.head_dim * \
+            jnp.dtype(store_dtype(self.store, self.dtype)).itemsize
+        if self.quantized:
+            per_head += 4                       # the riding f32 amax scale
+        return 2 * self.layers * self.kv_heads * per_head
 
 
 def init_cache(cfg: KVCacheConfig) -> dict:
-    """Zeroed ``{"k", "v"}`` cache (one extra physical row: the trash slot)."""
-    shape = (cfg.layers, cfg.slots + 1, cfg.max_len, cfg.kv_heads,
-             cfg.head_dim)
-    return {"k": jnp.zeros(shape, cfg.dtype), "v": jnp.zeros(shape, cfg.dtype)}
+    """Zeroed cache dict: ``{"k", "v"}`` payload pages (plus
+    ``{"k_scale", "v_scale"}`` when quantized)."""
+    shape = (cfg.layers, cfg.rows, cfg.max_len, cfg.kv_heads, cfg.head_dim)
+    dt = store_dtype(cfg.store, cfg.dtype)
+    cache = {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+    if cfg.quantized:
+        cache["k_scale"] = jnp.zeros(shape[:-1], jnp.float32)
+        cache["v_scale"] = jnp.zeros(shape[:-1], jnp.float32)
+    return cache
 
+
+# ---------------------------------------------------------------------------
+# Device-side page math (one layer's slice of the cache dict)
+# ---------------------------------------------------------------------------
 
 def append_rows(kl: jax.Array, vl: jax.Array, slots: jax.Array,
                 lengths: jax.Array, k_new: jax.Array, v_new: jax.Array):
-    """Scatter one new token's kv into per-request slots (decode append).
+    """Scatter one new token's raw kv into per-request slots.
 
-    ``kl/vl``: one layer's cache ``[slots+1, max_len, kv_heads, head_dim]``;
+    ``kl/vl``: one layer's pages ``[rows, max_len, kv_heads, head_dim]``;
     ``slots``/``lengths``: ``[S]`` int32 (the new token lands at index
     ``lengths[i]`` of ``slots[i]``); ``k_new/v_new``: ``[S, kv_heads,
     head_dim]``.  Duplicate (trash-slot) indices are allowed — last write
@@ -91,17 +204,111 @@ def append_rows(kl: jax.Array, vl: jax.Array, slots: jax.Array,
     return kl, vl
 
 
+def layer_append(cl: Dict[str, jax.Array], slots: jax.Array,
+                 lengths: jax.Array, k_new: jax.Array, v_new: jax.Array,
+                 store: str = "raw") -> Dict[str, jax.Array]:
+    """One decode token per lane into one layer's cache dict, quantizing
+    on the way in when the store calls for it."""
+    qk, sk = quantize_rows(k_new, store)
+    qv, sv = quantize_rows(v_new, store)
+    out = dict(cl)
+    out["k"], out["v"] = append_rows(cl["k"], cl["v"], slots, lengths,
+                                     qk, qv)
+    if sk is not None:
+        out["k_scale"] = cl["k_scale"].at[slots, lengths].set(sk)
+        out["v_scale"] = cl["v_scale"].at[slots, lengths].set(sv)
+    return out
+
+
+def layer_append_chunk(cl: Dict[str, jax.Array], slots: jax.Array,
+                       lengths: jax.Array, k_new: jax.Array,
+                       v_new: jax.Array,
+                       store: str = "raw") -> Dict[str, jax.Array]:
+    """Scatter a T-token chunk per lane (the k-token verify / chunked
+    prefill append): ``k_new/v_new`` are ``[S, T, kv_heads, head_dim]``
+    and token t of lane i lands at row ``lengths[i] + t`` of
+    ``slots[i]``."""
+    T = k_new.shape[1]
+    rows = slots[:, None]                                       # [S, 1]
+    pos = lengths[:, None] + jnp.arange(T)[None, :]             # [S, T]
+    qk, sk = quantize_rows(k_new, store)
+    qv, sv = quantize_rows(v_new, store)
+    out = dict(cl)
+    out["k"] = cl["k"].at[rows, pos].set(qk.astype(cl["k"].dtype))
+    out["v"] = cl["v"].at[rows, pos].set(qv.astype(cl["v"].dtype))
+    if sk is not None:
+        out["k_scale"] = cl["k_scale"].at[rows, pos].set(sk)
+        out["v_scale"] = cl["v_scale"].at[rows, pos].set(sv)
+    return out
+
+
+def layer_prefill(cl: Dict[str, jax.Array], slot_id: jax.Array,
+                  k: jax.Array, v: jax.Array,
+                  store: str = "raw") -> Dict[str, jax.Array]:
+    """Land a whole padded prompt's kv (``[Tpad, kv_heads, head_dim]``)
+    at rows ``0..Tpad-1`` of ``slot_id`` — the prefill write.  Positions
+    past the true length hold garbage that the length masks never read
+    before an append overwrites them."""
+    from jax import lax
+    qk, sk = quantize_rows(k, store)
+    qv, sv = quantize_rows(v, store)
+    out = dict(cl)
+    out["k"] = lax.dynamic_update_slice(
+        cl["k"], qk[None].astype(cl["k"].dtype), (slot_id, 0, 0, 0))
+    out["v"] = lax.dynamic_update_slice(
+        cl["v"], qv[None].astype(cl["v"].dtype), (slot_id, 0, 0, 0))
+    if sk is not None:
+        out["k_scale"] = lax.dynamic_update_slice(
+            cl["k_scale"], sk[None], (slot_id, 0, 0))
+        out["v_scale"] = lax.dynamic_update_slice(
+            cl["v_scale"], sv[None], (slot_id, 0, 0))
+    return out
+
+
+def _gather_pages(cl: Dict[str, jax.Array], slots: jax.Array,
+                  prefix_slots: Optional[jax.Array],
+                  prefix_lens: Optional[jax.Array]):
+    """Gather each lane's kv rows, reading **through the page
+    indirection**: key positions ``< prefix_lens[i]`` come from the
+    lane's shared prefix page, the rest from its private slot.  Returns
+    f32-dequantized ``(ks, vs)`` of shape ``[S, max_len, Hkv, Dh]``."""
+    ks, vs = cl["k"][slots], cl["v"][slots]
+    ksc = cl["k_scale"][slots] if "k_scale" in cl else None
+    vsc = cl["v_scale"][slots] if "v_scale" in cl else None
+    if prefix_slots is not None:
+        L = cl["k"].shape[1]
+        shared = (jnp.arange(L)[None, :]
+                  < prefix_lens[:, None])                       # [S, L]
+        sel = shared[..., None, None]
+        ks = jnp.where(sel, cl["k"][prefix_slots], ks)
+        vs = jnp.where(sel, cl["v"][prefix_slots], vs)
+        if ksc is not None:
+            ksc = jnp.where(shared[..., None], cl["k_scale"][prefix_slots],
+                            ksc)
+            vsc = jnp.where(shared[..., None], cl["v_scale"][prefix_slots],
+                            vsc)
+    ct = jnp.float32
+    return dequantize_rows(ks, ksc, ct), dequantize_rows(vs, vsc, ct)
+
+
 def attend_rows(q: jax.Array, kl: jax.Array, vl: jax.Array,
                 slots: jax.Array, lengths: jax.Array,
-                scale: Optional[float] = None) -> jax.Array:
+                scale: Optional[float] = None, *,
+                k_scale: Optional[jax.Array] = None,
+                v_scale: Optional[jax.Array] = None,
+                prefix_slots: Optional[jax.Array] = None,
+                prefix_lens: Optional[jax.Array] = None) -> jax.Array:
     """Masked decode attention of one new token per request over its slot.
 
     ``q``: ``[S, heads, head_dim]`` (heads may be ``group * kv_heads`` —
     grouped-query attention repeats each compact kv head over its group);
-    ``kl/vl``: one layer's cache (post-append); ``lengths``: the position
+    ``kl/vl``: one layer's pages (post-append); ``lengths``: the position
     the new token was appended at, so keys ``0 .. lengths[i]`` inclusive
-    are valid.  Same numerics as the dense oracle: f32-floor scores, scale
-    folded into q, ``-inf`` masking.
+    are valid.  ``k_scale/v_scale`` dequantize int8/fp8 pages on the fly;
+    ``prefix_slots/prefix_lens`` route key positions below the prefix
+    length through the lane's shared prefix page.  Same numerics as the
+    dense oracle: f32-floor scores, scale folded into q, ``-inf``
+    masking.
     """
     S, H, Dh = q.shape
     Hkv = kl.shape[-2]
@@ -109,8 +316,10 @@ def attend_rows(q: jax.Array, kl: jax.Array, vl: jax.Array,
         raise ValueError(f"{H} q heads not a multiple of {Hkv} kv heads")
     if scale is None:
         scale = Dh ** -0.5
-    ks = kl[slots]                              # [S, max_len, Hkv, Dh]
-    vs = vl[slots]
+    cl = {"k": kl, "v": vl}
+    if k_scale is not None:
+        cl["k_scale"], cl["v_scale"] = k_scale, v_scale
+    ks, vs = _gather_pages(cl, slots, prefix_slots, prefix_lens)
     if Hkv != H:
         ks = jnp.repeat(ks, H // Hkv, axis=2)
         vs = jnp.repeat(vs, H // Hkv, axis=2)
@@ -122,14 +331,52 @@ def attend_rows(q: jax.Array, kl: jax.Array, vl: jax.Array,
     return jnp.einsum("shl,slhd->shd", p, vs.astype(ct)).astype(q.dtype)
 
 
+def attend_chunk(q: jax.Array, cl: Dict[str, jax.Array], slots: jax.Array,
+                 lengths: jax.Array, scale: Optional[float] = None, *,
+                 prefix_slots: Optional[jax.Array] = None,
+                 prefix_lens: Optional[jax.Array] = None) -> jax.Array:
+    """Chunked causal attention for the k-token verify forward (and the
+    chunked prefill of a prefix-hit request): ``q`` is ``[S, T, heads,
+    head_dim]`` with query t of lane i sitting at position ``lengths[i] +
+    t``, attending over its slot's rows ``0 .. lengths[i] + t`` inclusive
+    (post :func:`layer_append_chunk`) — prefix pages and quantized
+    storage read exactly as in :func:`attend_rows`."""
+    S, T, H, Dh = q.shape
+    Hkv = cl["k"].shape[-2]
+    if H % Hkv:
+        raise ValueError(f"{H} q heads not a multiple of {Hkv} kv heads")
+    if scale is None:
+        scale = Dh ** -0.5
+    ks, vs = _gather_pages(cl, slots, prefix_slots, prefix_lens)
+    if Hkv != H:
+        ks = jnp.repeat(ks, H // Hkv, axis=2)
+        vs = jnp.repeat(vs, H // Hkv, axis=2)
+    L = cl["k"].shape[1]
+    ct = jnp.promote_types(q.dtype, jnp.float32)
+    s = jnp.einsum("sthd,slhd->sthl", q.astype(ct) * scale, ks.astype(ct))
+    qpos = lengths[:, None] + jnp.arange(T)[None, :]            # [S, T]
+    valid = jnp.arange(L)[None, None, :] <= qpos[:, :, None]    # [S, T, L]
+    s = jnp.where(valid[:, :, None, :], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("sthl,slhd->sthd", p, vs.astype(ct)).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Host-side bookkeeping
+# ---------------------------------------------------------------------------
+
 class SlotAllocator:
-    """Host-side free list over one replica's request slots.
+    """Host-side free heap over one replica's request slots.
 
     Continuous batching allocates a slot at admission and frees it at
     retirement (or eviction); the device-side cache rows are never zeroed —
     a recycled slot is overwritten by the next prefill and masked by its
     new length, which the slot-reuse test pins as bit-equivalent to a
-    fresh cache.
+    fresh cache.  The free list is a binary heap so both :meth:`alloc`
+    and :meth:`free` stay O(log slots) as slot counts grow with paged
+    sharing (the old list kept itself sorted with an O(n log n) sort per
+    free), while preserving the lowest-free-slot-first order the reuse
+    tests pin.
     """
 
     def __init__(self, slots: int, *, replica: int = 0):
@@ -137,14 +384,14 @@ class SlotAllocator:
             raise ValueError(f"need >= 1 slot, got {slots}")
         self.slots = int(slots)
         self.replica = int(replica)
-        self._free = list(range(self.slots - 1, -1, -1))   # pop() -> slot 0 first
+        self._free = list(range(self.slots))     # already a valid min-heap
         self._in_use: set = set()
 
     def alloc(self) -> Optional[int]:
         """Lowest free slot id, or None when the replica is full."""
         if not self._free:
             return None
-        slot = self._free.pop()
+        slot = heapq.heappop(self._free)
         self._in_use.add(slot)
         self._export()
         return slot
@@ -153,8 +400,7 @@ class SlotAllocator:
         if slot not in self._in_use:
             raise ValueError(f"slot {slot} is not allocated")
         self._in_use.discard(slot)
-        self._free.append(slot)
-        self._free.sort(reverse=True)
+        heapq.heappush(self._free, slot)
         self._export()
 
     @property
@@ -174,3 +420,154 @@ class SlotAllocator:
             "bluefog_serve_kv_occupancy",
             "KV-cache slot occupancy fraction, by replica").set(
                 self.occupancy, replica=str(self.replica))
+
+
+@dataclasses.dataclass
+class _Prefix:
+    row: int               # physical cache row holding the sealed pages
+    tokens: Tuple[int, ...]
+    digest: str            # content hash (flight bundles / debugging)
+    refs: int = 0
+    sealed: bool = False
+    tick: int = 0          # LRU clock
+
+
+class PrefixCache:
+    """Host-side content-addressed directory of shared prefix pages.
+
+    One replica's reserved prefix rows (physical rows ``slots ..
+    slots + pages - 1``) each hold ONE sealed prefix: a prompt prefix
+    whose length is a multiple of ``page_tokens``, hashed by content.
+    System-prompt-heavy traffic prefills the shared prefix once
+    (:meth:`admit` hands out the row, the engine seals it with a plain
+    prefill) and every later request with the same prefix attaches by
+    reference (:meth:`acquire` / :meth:`release` refcount the row);
+    the divergent suffix lands in the request's private slot, so the
+    shared pages are immutable after sealing — copy-on-write where the
+    "copy" is the suffix itself.  Refcount-0 entries are evicted LRU
+    when the pool is full.
+    """
+
+    def __init__(self, pages: int, page_tokens: int, first_row: int, *,
+                 replica: int = 0):
+        if pages < 1:
+            raise ValueError(f"need >= 1 prefix page, got {pages}")
+        if page_tokens < 1:
+            raise ValueError(f"page_tokens must be >= 1, got {page_tokens}")
+        self.pages = int(pages)
+        self.page_tokens = int(page_tokens)
+        self.first_row = int(first_row)
+        self.replica = int(replica)
+        self._free = list(range(first_row, first_row + pages))  # min-heap
+        self._by_key: Dict[Tuple[int, ...], _Prefix] = {}
+        self._by_row: Dict[int, _Prefix] = {}
+        self._tick = 0
+
+    # -- lookup --------------------------------------------------------
+
+    def _share_len(self, prompt: Sequence[int]) -> int:
+        """Longest shareable prefix length: whole pages, and at least one
+        prompt token left over to carry the request's own logits."""
+        return ((len(prompt) - 1) // self.page_tokens) * self.page_tokens
+
+    def match(self, prompt: Sequence[int]) -> Optional[Tuple[int, int]]:
+        """Longest sealed prefix of ``prompt``: ``(row, plen)`` or None."""
+        plen = self._share_len(prompt)
+        while plen >= self.page_tokens:
+            e = self._by_key.get(tuple(prompt[:plen]))
+            if e is not None and e.sealed:
+                return e.row, plen
+            plen -= self.page_tokens
+        return None
+
+    def acquire(self, prompt: Sequence[int]) -> Optional[Tuple[int, int]]:
+        """Attach to the longest sealed prefix (refcount + hit metrics)."""
+        got = self.match(prompt)
+        counter = _metrics.counter(
+            "bluefog_serve_prefix_hits_total"
+            if got else "bluefog_serve_prefix_misses_total",
+            "shared-prefix page lookups, by outcome")
+        counter.inc(replica=str(self.replica))
+        if got is None:
+            return None
+        row, plen = got
+        e = self._by_row[row]
+        self._tick += 1
+        e.refs, e.tick = e.refs + 1, self._tick
+        self._export()
+        return row, plen
+
+    def attach(self, row: int) -> None:
+        """Refcount a row WITHOUT the hit/miss metric — the seal-then-attach
+        path of the request that missed and prefilled the page itself."""
+        e = self._by_row[row]
+        self._tick += 1
+        e.refs, e.tick = e.refs + 1, self._tick
+        self._export()
+
+    def release(self, row: int) -> None:
+        e = self._by_row.get(row)
+        if e is None or e.refs < 1:
+            raise ValueError(f"prefix row {row} is not acquired")
+        e.refs -= 1
+        self._export()
+
+    # -- admission -----------------------------------------------------
+
+    def admit(self, prompt: Sequence[int]) -> Optional[Tuple[int, int]]:
+        """Reserve a page row for ``prompt``'s shareable prefix.
+
+        Returns ``(row, plen)`` for the engine to seal (prefill
+        ``prompt[:plen]`` into ``row``, then :meth:`seal`), or None when
+        the prefix is shorter than one page or the pool is exhausted by
+        in-use entries.  Evicts the LRU refcount-0 entry when full.
+        """
+        plen = self._share_len(prompt)
+        if plen < self.page_tokens:
+            return None
+        key = tuple(prompt[:plen])
+        if key in self._by_key:                  # racing admit: reuse it
+            return self._by_key[key].row, plen
+        if self._free:
+            row = heapq.heappop(self._free)
+        else:
+            idle = [e for e in self._by_row.values() if e.refs == 0]
+            if not idle:
+                return None
+            victim = min(idle, key=lambda e: e.tick)
+            del self._by_key[victim.tokens]
+            del self._by_row[victim.row]
+            row = victim.row
+        digest = hashlib.blake2s(
+            b",".join(str(t).encode() for t in key), digest_size=8
+        ).hexdigest()
+        e = _Prefix(row=row, tokens=key, digest=digest)
+        self._by_key[key] = e
+        self._by_row[row] = e
+        self._export()
+        return row, plen
+
+    def seal(self, row: int) -> None:
+        """Mark a row's pages as prefilled — attachable from now on."""
+        self._by_row[row].sealed = True
+
+    @property
+    def in_use(self) -> int:
+        return len(self._by_row)
+
+    def describe(self) -> dict:
+        """Flight-bundle block: what is resident, with content digests."""
+        return {
+            "pages": self.pages, "page_tokens": self.page_tokens,
+            "resident": [
+                {"row": e.row, "tokens": len(e.tokens), "refs": e.refs,
+                 "digest": e.digest, "sealed": e.sealed}
+                for e in sorted(self._by_row.values(),
+                                key=lambda e: e.row)],
+        }
+
+    def _export(self) -> None:
+        _metrics.gauge(
+            "bluefog_serve_prefix_pages_in_use",
+            "resident shared-prefix pages, by replica").set(
+                float(self.in_use), replica=str(self.replica))

@@ -2,28 +2,38 @@
 
 The scheduler owns everything dynamic so the engine can stay static: a
 FIFO admission queue, one :class:`~.kv_cache.SlotAllocator` per replica,
-and the per-request token state.  Each :meth:`Scheduler.step` does
+a :class:`~.kv_cache.PrefixCache` per replica when prefix sharing is
+armed, and the per-request token state.  Each :meth:`Scheduler.step` does
 
-1. **admit** — pop queued requests into free slots (prefill, one request
-   per call, prompt padded to a declared bucket);
+1. **admit** — pop queued requests into free slots.  With prefix pages
+   armed, each prompt first probes its replica's prefix directory: a hit
+   attaches the sealed page by reference and prefills ONLY the divergent
+   remainder (one chunk call); a shareable miss seals the prefix into a
+   reserved page on the way in, so the next request with the same system
+   prompt hits.  Cold prompts take the plain one-prefill path.
 2. **decode** — one fused engine call for ALL replicas at the smallest
    declared batch bucket that fits the busiest replica, idle lanes padded
-   with the trash slot;
+   with the trash slot.  With ``spec_decode=k`` armed this is one
+   speculative round (draft + verify) and each lane advances by its own
+   accepted count; otherwise it is ``decode_steps_per_call`` plain steps.
 3. **retire** — requests that hit ``max_new_tokens`` (or the KV-cache
-   length ceiling) free their slot and close their latency clocks.
+   length ceiling) free their slot, release their prefix page reference,
+   and close their latency clocks.
 
-Because admission only changes *which slot ids* ride in the bucketed
+Because admission only changes *which slot/page ids* ride in the bucketed
 arrays — never a shape — steady-state traffic re-runs the warmed programs
-and the retrace sentinel stays 0.
+and the retrace sentinel stays 0 with all three fast paths armed.
 
 Request metrics ride the existing registry (JSONL/Prometheus exporters
 and ``tools/metrics_report.py`` pick them up with no schema changes):
 ``bluefog_requests_total{status=...}``, ``bluefog_tokens_generated_total``,
-and the ``bluefog_serve_token_latency_seconds`` histogram (p50/p99 via
-``histogram().percentile``).  A ``serve`` flight-bundle block
+the ``bluefog_serve_token_latency_seconds`` histogram (p50/p99 via
+``histogram().percentile``), and the paired
+``bluefog_serve_ttft_{hit,cold}_seconds`` histograms — the serve_bench
+TTFT-under-prefix-hits row.  A ``serve`` flight-bundle block
 (:func:`bluefog_tpu.utils.flight.register_block`) carries the last
-request ids per replica so ``tools/postmortem.py`` can blame the replica
-that died mid-stream.
+request ids per replica plus the resident prefix pages so
+``tools/postmortem.py`` can blame the replica that died mid-stream.
 """
 from __future__ import annotations
 
@@ -37,7 +47,7 @@ import numpy as np
 from ..utils import flight as _flight
 from ..utils import metrics as _metrics
 from .engine import ServeEngine
-from .kv_cache import SlotAllocator
+from .kv_cache import PrefixCache, SlotAllocator
 
 __all__ = ["Request", "Scheduler"]
 
@@ -54,6 +64,8 @@ class Request:
     state: str = "queued"            # queued -> running -> done | failed
     replica: int = -1
     slot: int = -1
+    prefix_row: int = -1             # sealed page this request reads through
+    prefix_len: int = 0              # tokens served by that page
     generated: List[int] = dataclasses.field(default_factory=list)
     submitted_at: float = 0.0
     first_token_at: Optional[float] = None
@@ -80,6 +92,12 @@ class Scheduler:
         self._queue: Deque[Request] = deque()
         self._alloc = [SlotAllocator(engine.scfg.slots, replica=r)
                        for r in range(self.replicas)]
+        scfg = engine.scfg
+        self._prefix: List[Optional[PrefixCache]] = [
+            PrefixCache(scfg.prefix_pages, scfg.prefix_page_tokens,
+                        first_row=scfg.slots, replica=r)
+            if scfg.prefix_pages else None
+            for r in range(self.replicas)]
         self._active: List[Dict[int, Request]] = [
             {} for _ in range(self.replicas)]
         self._dead: set = set()
@@ -124,8 +142,10 @@ class Scheduler:
     def fail_replica(self, replica: int) -> List[Request]:
         """Take a replica out of rotation (chaos kill / health eviction).
 
-        Its in-flight requests fail (their KV lived on the dead slice);
-        queued requests are untouched and will admit onto survivors.
+        Its in-flight requests fail (their KV — and any shared prefix
+        pages — lived on the dead slice); queued requests are untouched
+        and will admit onto survivors, re-sealing prefixes there on
+        first miss.
         """
         if replica in self._dead:
             return []
@@ -165,6 +185,43 @@ class Scheduler:
 
     # ------------------------------------------------------------------
 
+    def _prefill_request(self, req: Request) -> int:
+        """Prefill one admitted request — through a shared prefix page when
+        one matches — and return its first token.  Observes the TTFT
+        histogram with the hit/cold split."""
+        r, pc = req.replica, self._prefix[req.replica]
+        hit = False
+        if pc is not None:
+            got = pc.acquire(req.prompt)
+            if got is None:
+                adm = pc.admit(req.prompt)
+                if adm is not None:
+                    # shareable miss: seal the prefix on the way in, then
+                    # read through it ourselves — the "copy" of CoW is the
+                    # divergent suffix landing in our private slot
+                    row, plen = adm
+                    self.engine.seal_prefix(r, row, req.prompt[:plen])
+                    pc.seal(row)
+                    pc.attach(row)
+                    req.prefix_row, req.prefix_len = row, plen
+            else:
+                req.prefix_row, req.prefix_len = got
+                hit = True
+        if req.prefix_row >= 0:
+            first = self.engine.chunk_prefill(
+                r, req.slot, req.prompt[req.prefix_len:],
+                req.prefix_len, req.prefix_row)
+        else:
+            first, _ = self.engine.prefill(r, req.slot, req.prompt)
+        req.first_token_at = time.monotonic()
+        _metrics.histogram(
+            "bluefog_serve_ttft_hit_seconds" if hit
+            else "bluefog_serve_ttft_cold_seconds",
+            "time to first token, by prefix-cache outcome",
+            buckets=LATENCY_BUCKETS).observe(
+                req.first_token_at - req.submitted_at)
+        return first
+
     def _admit(self) -> None:
         # a lane needs a free KV slot AND a decode lane: never admit past
         # the largest declared batch bucket — undeclared lane counts have
@@ -172,21 +229,29 @@ class Scheduler:
         lane_cap = min(self.engine.scfg.slots,
                        self.engine.scfg.batch_buckets[-1])
         while self._queue:
-            target = None
-            for r in sorted(self.live_replicas(),
-                            key=lambda r: len(self._active[r])):
+            candidates = [
+                r for r in self.live_replicas()
                 if (self._alloc[r].in_use < self.engine.scfg.slots
-                        and len(self._active[r]) < lane_cap):
-                    target = r
-                    break
-            if target is None:
+                    and len(self._active[r]) < lane_cap)]
+            if not candidates:
                 return                       # every live replica is full
+            # prefix-affine routing: a replica already holding this
+            # prompt's sealed prefix saves the whole shared prefill, which
+            # beats perfect load balance; longest match wins, load breaks
+            # ties.  Prefix caches are per-replica (the pages live in that
+            # replica's cache rows), so without affinity a hot system
+            # prompt would be re-sealed on every replica it strays to.
+            head = self._queue[0]
+            def _rank(r):
+                pc = self._prefix[r]
+                got = pc.match(head.prompt) if pc is not None else None
+                return (-(got[1] if got else 0), len(self._active[r]), r)
+            target = min(candidates, key=_rank)
             req = self._queue.popleft()
             slot = self._alloc[target].alloc()
             req.replica, req.slot, req.state = target, slot, "running"
             t0 = time.monotonic()
-            first, _ = self.engine.prefill(target, slot, req.prompt)
-            req.first_token_at = time.monotonic()
+            first = self._prefill_request(req)
             req.generated.append(first)
             _metrics.counter(
                 "bluefog_tokens_generated_total",
@@ -204,29 +269,45 @@ class Scheduler:
         busiest = max((len(l) for l in lanes), default=0)
         if busiest == 0:
             return []
-        S = self.engine.scfg.batch_bucket_for(busiest)
+        scfg = self.engine.scfg
+        S = scfg.batch_bucket_for(busiest)
         idle_tok, idle_slot, idle_len = self.engine.idle_lane()
         R = self.replicas
         toks = np.full((R, S), idle_tok, np.int32)
         slots = np.full((R, S), idle_slot, np.int32)
         lens = np.full((R, S), idle_len, np.int32)
+        prows = np.full((R, S), idle_slot, np.int32)
+        plens = np.zeros((R, S), np.int32)
         for r in range(R):
             for i, slot in enumerate(lanes[r]):
                 req = self._active[r][slot]
                 toks[r, i] = req.generated[-1]
                 slots[r, i] = slot
                 lens[r, i] = req.next_pos
+                if req.prefix_row >= 0:
+                    prows[r, i] = req.prefix_row
+                    plens[r, i] = req.prefix_len
+        pargs = (prows, plens) if self._prefix[0] is not None else (None,
+                                                                    None)
         t0 = time.monotonic()
-        gen = self.engine.decode(toks, slots, lens)   # [R, steps, S]
+        if scfg.spec_decode:
+            emitted, counts = self.engine.spec_decode(toks, slots, lens,
+                                                      *pargs)
+            gen_tokens = lambda r, i: \
+                [int(t) for t in emitted[r, i, :counts[r, i]]]
+            steps = int(counts.max())
+        else:
+            gen = self.engine.decode(toks, slots, lens, *pargs)
+            steps = gen.shape[1]                          # [R, steps, S]
+            gen_tokens = lambda r, i: [int(t) for t in gen[r, :, i]]
         dt = time.monotonic() - t0
-        steps = gen.shape[1]
         n_tokens = 0
         retired: List[Request] = []
         for r in range(R):
             for i, slot in enumerate(lanes[r]):
                 req = self._active[r][slot]
                 room = req.max_new_tokens - len(req.generated)
-                new = [int(t) for t in gen[r, :, i][:room]]
+                new = gen_tokens(r, i)[:room]
                 req.generated.extend(new)
                 n_tokens += len(new)
                 done = self._maybe_retire(req)
@@ -241,20 +322,23 @@ class Scheduler:
                 "per-token serve latency (prefill + decode)",
                 buckets=LATENCY_BUCKETS)
             for _ in range(min(steps, 64)):   # bounded observer cost
-                h.observe(dt / steps)
+                h.observe(dt / max(steps, 1))
         return retired
 
     def _maybe_retire(self, req: Request) -> bool:
-        # the next fused call appends at next_pos .. next_pos + steps - 1,
-        # all of which must fit under the per-slot capacity
-        steps = self.engine.scfg.decode_steps_per_call
+        # the next fused call appends at next_pos .. next_pos + window - 1,
+        # all of which must fit under the per-slot capacity (the window is
+        # a speculative round's k + 1 when spec decode is armed)
+        window = self.engine.scfg.decode_window
         if (len(req.generated) < req.max_new_tokens
-                and req.next_pos + steps <= self.engine.scfg.max_len):
+                and req.next_pos + window <= self.engine.scfg.max_len):
             return False
         req.state = "done"
         req.finished_at = time.monotonic()
         self._active[req.replica].pop(req.slot, None)
         self._alloc[req.replica].free(req.slot)
+        if req.prefix_row >= 0:
+            self._prefix[req.replica].release(req.prefix_row)
         self.completed.append(req)
         _metrics.counter(
             "bluefog_requests_total",
@@ -265,7 +349,7 @@ class Scheduler:
 
     def _flight_block(self) -> dict:
         """The ``serve`` bundle block postmortem reads after a chaos kill."""
-        return {
+        block = {
             "replicas": self.replicas,
             "dead_replicas": sorted(self._dead),
             "pending": self.pending,
@@ -277,6 +361,11 @@ class Scheduler:
             "completed": len(self.completed),
             "failed": [r.id for r in self.failed],
         }
+        if self._prefix[0] is not None:
+            block["prefix_pages"] = {
+                str(r): self._prefix[r].describe()
+                for r in self.live_replicas() if self._prefix[r].in_use}
+        return block
 
     def close(self) -> None:
         _flight.unregister_block("serve")

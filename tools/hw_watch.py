@@ -222,6 +222,17 @@ def _battery_steps(tag: str, stage: int = 0) -> list:
                        "--pp", "2", "--out",
                        os.path.join(m, f"serve_bench_{tag}.json")],
                       2400, None, None))
+        # the fast-path row: self-speculative decoding (3-deep draft off
+        # the first pipeline stage), int8 KV pages, and shared prefix
+        # pages — same carving, gated on spec bit-identity + prefix-hit
+        # TTFT beating cold + int8 halving KV bytes/token
+        steps.append(("serve_bench_fast",
+                      [py, sb, "--train-dp", "2", "--serve-dp", "2",
+                       "--pp", "2", "--spec-decode", "3@1",
+                       "--kv-dtype", "int8", "--prefix-pages", "2x8",
+                       "--out",
+                       os.path.join(m, f"serve_bench_fast_{tag}.json")],
+                      2400, None, None))
     # the async-gossip headline: one rank throttled 10x on the real mesh,
     # async wall-clock-to-consensus vs lockstep on the same push schedule
     # (cheap: two small-strategy compiles, tens of gossip ticks)
@@ -300,6 +311,12 @@ def _rehearsal_steps(tag: str) -> list:
          [py, os.path.join(REPO, "tools", "serve_bench.py"),
           "--virtual-cpu", "--smoke",
           "--out", os.path.join(m, f"serve_bench_{tag}.json")], 900,
+         None, None),
+        ("serve_bench_fast",
+         [py, os.path.join(REPO, "tools", "serve_bench.py"),
+          "--virtual-cpu", "--smoke", "--spec-decode", "3@1",
+          "--kv-dtype", "int8", "--prefix-pages", "2x8",
+          "--out", os.path.join(m, f"serve_bench_fast_{tag}.json")], 900,
          None, None),
         ("async_frontier",
          [py, os.path.join(REPO, "tools", "gossip_bench.py"),

@@ -19,15 +19,31 @@ mid-traffic.  Grades serving on every axis ISSUE 10's claim rides on:
   ``bluefog_serve_staleness_steps`` gauge, and the pull count — the
   freshness the gossip leaf actually delivered under load;
 * **invariants**: KV-cache donation intact after the drain, retrace
-  sentinel 0 after warmup (every served shape hit a declared bucket).
+  sentinel 0 after warmup (every served shape hit a declared bucket);
 
-Emits a ``bluefog-serve-bench-1`` JSON artifact (last stdout line, and
+and, when the fast paths are armed (schema 2 rows):
+
+* **speculative decoding** (``--spec-decode k[@stages]``): acceptance
+  rate, accepted-tokens/s, and a bit-identity probe — the same prompts
+  decoded by a plain-greedy reference engine must produce byte-identical
+  token streams;
+* **prefix sharing** (``--prefix-pages P[xT]``): hit/miss counts plus a
+  same-prompt TTFT probe — the second, prefix-hit submission of an
+  identical prompt must beat the cold one that sealed the page;
+* **KV quantization** (``--kv-dtype int8|fp8``): KV bytes/token against
+  the raw layout (the float64 logit-drift bound is pinned in
+  ``tests/test_serve_fast.py``).
+
+Emits a ``bluefog-serve-bench-2`` JSON artifact (last stdout line, and
 ``--out``).
 
 Run:    python tools/serve_bench.py --train-dp 2 --serve-dp 2 --pp 2 --out ...
 Smoke:  python tools/serve_bench.py --virtual-cpu --smoke
+Fast:   python tools/serve_bench.py --virtual-cpu --smoke \
+            --spec-decode 3@1 --prefix-pages 2x8 --kv-dtype int8
 """
 import argparse
+import dataclasses
 import importlib.util
 import json
 import os
@@ -37,7 +53,7 @@ import time
 REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
 sys.path.insert(0, REPO)
 
-SCHEMA = "bluefog-serve-bench-1"
+SCHEMA = "bluefog-serve-bench-2"
 
 
 def _load_tool(name):
@@ -78,6 +94,15 @@ def main():
                     help="KV rows per slot (default 64)")
     ap.add_argument("--decode-steps-per-call", type=int, default=None,
                     help="fused decode steps per engine call (default 2)")
+    ap.add_argument("--spec-decode", default=None,
+                    help="self-speculative decoding: '<k>' or '<k>@<stages>'"
+                         " draft depth / draft pipeline stages (default off)")
+    ap.add_argument("--kv-dtype", default=None,
+                    choices=("raw", "int8", "fp8"),
+                    help="KV page storage (default raw)")
+    ap.add_argument("--prefix-pages", default=None,
+                    help="shared prefix pages: '<pages>' or "
+                         "'<pages>x<page_tokens>' (default off)")
     ap.add_argument("--train-steps", type=int, default=None,
                     help="train steps interleaved with serving (default 6)")
     ap.add_argument("--refresh-every", type=int, default=None,
@@ -154,6 +179,18 @@ def main():
 
     sc_kw = dict(slots=slots, max_len=max_len,
                  decode_steps_per_call=steps_per_call)
+    if args.spec_decode:
+        k_s, _, st_s = args.spec_decode.partition("@")
+        sc_kw["spec_decode"] = int(k_s)
+        if st_s:
+            sc_kw["spec_stages"] = int(st_s)
+    if args.kv_dtype:
+        sc_kw["kv_dtype"] = args.kv_dtype
+    if args.prefix_pages:
+        pg_s, _, pt_s = args.prefix_pages.partition("x")
+        sc_kw["prefix_pages"] = int(pg_s)
+        if pt_s:
+            sc_kw["prefix_page_tokens"] = int(pt_s)
     if args.buckets:
         bb, pb = _parse_buckets(args.buckets)
         scfg = ServeConfig(batch_buckets=bb, prefill_buckets=pb, **sc_kw)
@@ -173,17 +210,79 @@ def main():
     serve_params = compose.init_lm_params(cfg, serve_m, seed=0)
     engine = ServeEngine(serve_m, cfg, serve_params, scfg)
     engine.warmup()
+
+    rng = np.random.default_rng(0)
+
+    def _drain_tokens(eng, prompts):
+        """Drain ``prompts`` through a throwaway scheduler; per-request
+        token streams (probe harness — closed before the traffic run)."""
+        s = Scheduler(eng)
+        reqs = [s.submit(p, max_new_tokens=max_new) for p in prompts]
+        s.drain()
+        s.close()
+        return reqs
+
+    # probe (a): speculative bit-identity — the same prompts through a
+    # plain-greedy reference engine must produce identical token streams
+    spec_probe = None
+    if scfg.spec_decode:
+        probe_prompts = [rng.integers(0, vocab, int(rng.integers(
+            2, scfg.prefill_buckets[-1] + 1))).tolist() for _ in range(3)]
+        ref_eng = ServeEngine(serve_m, cfg, serve_params,
+                              dataclasses.replace(scfg, spec_decode=0))
+        ref_eng.warmup()
+        ref = [r.generated for r in _drain_tokens(ref_eng, probe_prompts)]
+        got = [r.generated for r in _drain_tokens(engine, probe_prompts)]
+        spec_probe = {"prompts": len(probe_prompts),
+                      "bit_identical": bool(ref == got)}
+        del ref_eng
+
+    # probe (b): prefix-hit TTFT — an identical prompt submitted twice;
+    # the first seals the shared page (cold), the second attaches (hit)
+    prefix_probe = None
+    if scfg.prefix_pages:
+        ptoks = scfg.prefix_page_tokens
+        shared = rng.integers(0, vocab, ptoks).tolist()
+        probe_prompt = shared + rng.integers(
+            0, vocab, max(1, min(4, scfg.prefill_buckets[-1] - ptoks))
+        ).tolist()
+        cold = _drain_tokens(engine, [probe_prompt])[0]
+        hit = _drain_tokens(engine, [probe_prompt])[0]
+        prefix_probe = {
+            "ttft_cold_s": round(cold.ttft, 6),
+            "ttft_hit_s": round(hit.ttft, 6),
+            "hit_prefix_len": hit.prefix_len,
+            "hit_faster": bool(hit.ttft < cold.ttft),
+            "tokens_identical": bool(cold.generated == hit.generated)}
+    else:
+        shared = None
+
     refresher = WeightRefresher(engine, train_m, every=refresh_every)
     sched = Scheduler(engine)
     cache_probe = engine.cache["k"]       # donated into the first decode
 
-    rng = np.random.default_rng(0)
+    spec0 = {n: bfm.counter(n).total() for n in
+             ("bluefog_serve_spec_drafted_total",
+              "bluefog_serve_spec_accepted_total")}
+    hitmiss0 = {n: bfm.counter(n).total() for n in
+                ("bluefog_serve_prefix_hits_total",
+                 "bluefog_serve_prefix_misses_total")}
+    tokens0 = bfm.counter("bluefog_tokens_generated_total").total()
+
     prompt_lens = []
-    for _ in range(n_requests):
-        n = int(rng.integers(2, scfg.prefill_buckets[-1] + 1))
-        prompt_lens.append(n)
-        sched.submit(rng.integers(0, vocab, n).tolist(),
-                     max_new_tokens=max_new)
+    for i in range(n_requests):
+        if shared is not None and i % 2 == 0:
+            # the million-user shape: half the traffic reuses one system
+            # prompt — its page seals once per replica and then every
+            # admission is a remainder-only chunk prefill
+            room = scfg.prefill_buckets[-1] - len(shared)
+            p = shared + rng.integers(
+                0, vocab, int(rng.integers(1, room + 1))).tolist()
+        else:
+            n = int(rng.integers(2, scfg.prefill_buckets[-1] + 1))
+            p = rng.integers(0, vocab, n).tolist()
+        prompt_lens.append(len(p))
+        sched.submit(p, max_new_tokens=max_new)
 
     # -- interleaved drain: serve steps with training advancing live --------
     stal_max, pulls, train_done = 0.0, 0, 0
@@ -204,7 +303,9 @@ def main():
     dt = time.perf_counter() - t0
     stal_final = refresher.staleness()
 
-    tokens = int(bfm.counter("bluefog_tokens_generated_total").total())
+    # probes above generate tokens too — tokens/s uses the timed-drain delta
+    tokens = int(bfm.counter("bluefog_tokens_generated_total").total()
+                 - tokens0)
     tok_per_sec = tokens / dt if dt > 0 else None
 
     lat = bfm.get_metric("bluefog_serve_token_latency_seconds")
@@ -226,6 +327,52 @@ def main():
     serve_chips = args.serve_dp * slice_sz
 
     retraces = int(bfm.counter("bluefog_retrace_after_warmup_total").total())
+
+    # -- fast-path rows (schema 2) ------------------------------------------
+    spec_doc = None
+    if scfg.spec_decode:
+        drafted = int(bfm.counter("bluefog_serve_spec_drafted_total").total()
+                      - spec0["bluefog_serve_spec_drafted_total"])
+        accepted = int(
+            bfm.counter("bluefog_serve_spec_accepted_total").total()
+            - spec0["bluefog_serve_spec_accepted_total"])
+        spec_doc = {
+            "k": scfg.spec_decode,
+            "stages": scfg.spec_stages,
+            "cost_fraction": round(engine.draft.cost_fraction, 4),
+            "drafted": drafted,
+            "accepted": accepted,
+            "acceptance_rate": (round(accepted / drafted, 4)
+                                if drafted else None),
+            "accepted_tokens_per_sec": (round(accepted / dt, 1)
+                                        if dt > 0 else None),
+            **spec_probe,
+        }
+    prefix_doc = None
+    if scfg.prefix_pages:
+        hits = int(bfm.counter("bluefog_serve_prefix_hits_total").total()
+                   - hitmiss0["bluefog_serve_prefix_hits_total"])
+        misses = int(bfm.counter("bluefog_serve_prefix_misses_total").total()
+                     - hitmiss0["bluefog_serve_prefix_misses_total"])
+        prefix_doc = {
+            "pages": scfg.prefix_pages,
+            "page_tokens": scfg.prefix_page_tokens,
+            "hits": hits,
+            "misses": misses,
+            **prefix_probe,
+        }
+    kv_doc = None
+    if engine.cache_cfg.quantized:
+        bpt = engine.cache_cfg.bytes_per_token()
+        raw_bpt = dataclasses.replace(
+            engine.cache_cfg, store="raw").bytes_per_token()
+        kv_doc = {
+            "dtype": scfg.kv_dtype,
+            "bytes_per_token": bpt,
+            "raw_bytes_per_token": raw_bpt,
+            "ratio": round(bpt / raw_bpt, 4),
+        }
+
     doc = {
         "schema": SCHEMA,
         "ok": True,
@@ -236,7 +383,9 @@ def main():
                   "decode_steps_per_call": steps_per_call,
                   "batch_buckets": list(scfg.batch_buckets),
                   "prefill_buckets": list(scfg.prefill_buckets),
-                  "kv_cache_bytes": engine.cache_cfg.bytes()},
+                  "kv_dtype": scfg.kv_dtype,
+                  "kv_cache_bytes": engine.cache_cfg.bytes(),
+                  "kv_bytes_per_token": engine.cache_cfg.bytes_per_token()},
         "train": {"replicas": args.train_dp, "steps": train_done},
         "config": {"d_model": d_model, "heads": heads, "layers": layers,
                    "vocab": vocab, "n_params": cfg.n_params},
@@ -269,14 +418,27 @@ def main():
         "refresh": {"every": refresher.every, "pulls": pulls,
                     "staleness_max_steps": stal_max,
                     "staleness_final_steps": stal_final},
+        "spec": spec_doc,
+        "prefix": prefix_doc,
+        "kv": kv_doc,
         "invariants": {
             "donation_intact": bool(cache_probe.is_deleted()),
             "retraces_after_warmup": retraces,
         },
     }
+    fast_ok = True
+    if spec_doc is not None:
+        fast_ok &= spec_doc["bit_identical"]
+    if prefix_doc is not None:
+        fast_ok &= bool(prefix_doc["hit_faster"]
+                        and prefix_doc["tokens_identical"]
+                        and prefix_doc["hits"] >= 1)
+    if kv_doc is not None and scfg.kv_dtype == "int8":
+        fast_ok &= kv_doc["ratio"] <= 0.5
     doc["ok"] = bool(len(sched.completed) == n_requests
                      and doc["invariants"]["donation_intact"]
                      and retraces == 0
+                     and fast_ok
                      and (train_steps == 0 or pulls >= 1))
     sched.close()
     _emit(doc, args.out)

@@ -8,7 +8,7 @@ PY ?= python
 	test_hier test_native test_examples verify native clean hw-watch \
 	obs-smoke chaos-smoke overlap-smoke postmortem-smoke pod-smoke \
 	autotune-smoke elastic-smoke lm-smoke serve-smoke serve-fast-smoke \
-	async-smoke
+	async-smoke regrow-smoke
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -177,7 +177,7 @@ serve-smoke:
 		--out /tmp/serve_bench_smoke.json
 	$(PY) -c "import json; \
 		d = json.load(open('/tmp/serve_bench_smoke.json')); \
-		assert d['schema'] == 'bluefog-serve-bench-2' and d['ok'], d; \
+		assert d['schema'] == 'bluefog-serve-bench-3' and d['ok'], d; \
 		i = d['invariants']; \
 		assert i['donation_intact'] and \
 		i['retraces_after_warmup'] == 0, i; \
@@ -200,7 +200,7 @@ serve-fast-smoke:
 		--out /tmp/serve_bench_fast_smoke.json
 	$(PY) -c "import json; \
 		d = json.load(open('/tmp/serve_bench_fast_smoke.json')); \
-		assert d['schema'] == 'bluefog-serve-bench-2' and d['ok'], d; \
+		assert d['schema'] == 'bluefog-serve-bench-3' and d['ok'], d; \
 		s = d['spec']; \
 		assert s['bit_identical'] and s['drafted'] > 0, s; \
 		p = d['prefix']; \
@@ -210,6 +210,39 @@ serve-fast-smoke:
 		assert k['ratio'] <= 0.5, k; \
 		assert d['invariants']['retraces_after_warmup'] == 0, d; \
 		print('serve-fast-smoke OK')"
+
+# mesh-regrowth smoke: the regrow pytest battery (reinit, carry oracle,
+# chaos abort/rollback, autoscaler) plus the subprocess grow-by-2 drill —
+# its flight bundle must yield a committed-regrowth postmortem verdict —
+# and the serve_bench bursty traffic trace gated on the schema-3 row
+# (grow event fired, SLO recovered under the bound, zero failed requests)
+regrow-smoke:
+	$(PY) -m pytest tests/test_regrow.py -q -m "not slow"
+	rm -rf /tmp/regrow_flight
+	$(PY) tools/regrow_drill.py --virtual-cpu 8 --world 4 --target 6 \
+		--flight-dir /tmp/regrow_flight
+	$(PY) tools/postmortem.py --dir /tmp/regrow_flight \
+		--out /tmp/postmortem_regrow.json
+	$(PY) -c "import json; \
+		d = json.load(open('/tmp/postmortem_regrow.json')); \
+		assert d['ok'] and d['schema'] == 'bluefog-flight-1', d; \
+		r = d['regrow']; \
+		assert r['world_before'] == 4 and r['world_after'] == 6, r; \
+		assert r['committed'] and r['coordinator'] == 0, r; \
+		assert r['timeline'], r; \
+		print('regrow drill postmortem OK')"
+	$(PY) tools/serve_bench.py --virtual-cpu --smoke \
+		--traffic-trace flash-crowd --out /tmp/serve_bench_trace.json
+	$(PY) -c "import json; \
+		d = json.load(open('/tmp/serve_bench_trace.json')); \
+		assert d['schema'] == 'bluefog-serve-bench-3' and d['ok'], d; \
+		t = d['trace']; \
+		assert t['ok'] and t['failed'] == 0, t; \
+		assert t['grow_step'] is not None and \
+		t['recovery_steps'] <= t['recovery_bound_steps'], t; \
+		assert any(e['action'] == 'grow' for e in t['scale_events']), t; \
+		assert d['invariants']['retraces_after_warmup'] == 0, d; \
+		print('regrow-smoke OK')"
 
 # resilience smoke: deterministic fault injection + healing/rollback on
 # the virtual CPU mesh (kill->heal->contract, NaN->rollback, restart

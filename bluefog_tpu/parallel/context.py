@@ -281,6 +281,130 @@ def init(
     return ctx
 
 
+def reinit(world_size: int, *,
+           topology_fn: Optional[Callable[[], nx.DiGraph]] = None,
+           is_weighted: bool = False) -> BlueFogTpuContext:
+    """Tear down and re-form the mesh at a new world size (mesh regrowth).
+
+    The checkpoint-free re-bootstrap primitive behind
+    :func:`bluefog_tpu.resilience.regrow_world`: the frozen-at-``init``
+    SPMD world is replaced by a new one at ``world_size`` ranks.  Surviving
+    ranks keep their devices (rank ``r < old_size`` stays on the device it
+    already owned, so host-memory state carry re-shards onto the same
+    physical buffers); joiners take unused devices from the backend pool.
+    The compiled-program cache is dropped (every cached executable names
+    the old mesh), the compose carving is rebuilt at the new data-parallel
+    width when one is active, the resilience membership registry is
+    re-baselined, and the steady-state flag resets — the recompiles that
+    follow are the intended cost of a world change, not a retrace bug.
+
+    In a multi-process job the ``jax.distributed`` client is torn down and
+    re-formed at the new process count (the supervisor has already spawned
+    the joiner processes); the single-process SPMD simulation skips that
+    step — growth there means carving more of the virtual device pool.
+
+    Returns the new context.  Raises if no context is initialized or the
+    backend cannot supply ``world_size`` devices.
+    """
+    global _context, _active_compose
+    ctx = get_context()
+    world_size = int(world_size)
+    if world_size < 1:
+        raise ValueError(f"world_size must be >= 1, got {world_size}")
+    old = list(ctx.devices)
+    if world_size <= len(old):
+        devs_list = old[:world_size]
+    else:
+        platform = getattr(old[0], "platform", None)
+        pool = jax.devices(platform) if platform else jax.devices()
+        have = {id(d) for d in old}
+        spare = [d for d in pool if id(d) not in have]
+        need = world_size - len(old)
+        if len(spare) < need:
+            raise ValueError(
+                f"cannot regrow to {world_size} ranks: backend has only "
+                f"{len(old) + len(spare)} device(s) "
+                f"({len(old)} in use + {len(spare)} spare)")
+        devs_list = old + spare[:need]
+    _rebootstrap_distributed(world_size)
+
+    from ..utils import metrics as _metrics
+    from ..utils import flight as _flight
+    clear_program_cache()       # every cached executable names the old mesh
+    _metrics.mark_steady_state(False)
+
+    devs = np.asarray(devs_list, dtype=object)
+    npm = ctx.nodes_per_machine
+    if npm == ctx.size or world_size % npm != 0:
+        npm = world_size        # single machine (or no longer divisible)
+    mesh = Mesh(devs, ("rank",))
+    mesh_2d = Mesh(devs.reshape(world_size // npm, npm),
+                   ("machine", "local"))
+    topo = (topology_fn() if topology_fn is not None
+            else topo_util.ExponentialGraph(world_size))
+    new_ctx = BlueFogTpuContext(
+        devices=devs, nodes_per_machine=npm, mesh=mesh, mesh_2d=mesh_2d,
+        topology=_check_topology(topo, world_size),
+        topology_weighted=is_weighted,
+        round_parallel=ctx.round_parallel, dcn_wire=ctx.dcn_wire,
+        async_staleness=ctx.async_staleness)
+
+    old_compose = _active_compose
+    with _lock:
+        _context = new_ctx
+        _active_compose = None
+    if old_compose is not None:
+        slice_size = old_compose.slice_size
+        if world_size % slice_size:
+            raise ValueError(
+                f"world size {world_size} is not a multiple of the active "
+                f"carving's slice size {slice_size} "
+                f"(pp={old_compose.pp} tp={old_compose.tp} "
+                f"sp={old_compose.sp})")
+        from . import compose as _compose
+        _compose.compose_parallelism(
+            world_size // slice_size, old_compose.pp, old_compose.tp,
+            old_compose.sp, devices=devs_list, wire=old_compose.wire)
+
+    # the old world's membership registry (and its pristine baseline) is
+    # meaningless against the new mesh — re-baseline from scratch
+    from .. import resilience as _rz
+    _rz.reset()
+    _flight.record("lifecycle", name="reinit", devices=world_size,
+                   old_devices=len(old))
+    return new_ctx
+
+
+def _rebootstrap_distributed(world_size: int) -> bool:
+    """Tear down and re-form the ``jax.distributed`` client for a regrown
+    world.  Only in a real multi-process job (``BLUEFOG_COORDINATOR`` set
+    AND more than one process): the single-process simulation has no
+    client to re-form and must not dial a coordinator."""
+    if not os.environ.get("BLUEFOG_COORDINATOR"):
+        return False
+    if int(os.environ.get("BLUEFOG_NUM_PROCESSES", "1")) <= 1:
+        return False
+    try:
+        jax.distributed.shutdown()
+    except Exception:            # pragma: no cover - never formed / torn
+        pass
+    os.environ["BLUEFOG_NUM_PROCESSES"] = str(int(world_size))
+    from ..run.launcher import maybe_initialize_distributed
+    return maybe_initialize_distributed()
+
+
+def _install(ctx: BlueFogTpuContext, compose=None) -> None:
+    """Reinstall a previously captured context (the regrow rollback path:
+    a failed :func:`reinit` must leave the process on the old world)."""
+    global _context, _active_compose
+    clear_program_cache()
+    with _lock:
+        _context = ctx
+        _active_compose = compose
+    from ..utils import metrics as _metrics
+    _metrics.mark_steady_state(False)
+
+
 def _auto_hierarchy(devices: List, nodes_per_machine: Optional[int]):
     """Derive the (ordered devices, nodes_per_machine) two-level grouping.
 

@@ -62,6 +62,8 @@ __all__ = [
     "bootstrap_params", "join_rank", "chaos_join",
     "dead_ranks", "retired_ranks", "live_ranks", "reset",
     "GuardedStep", "guard_step",
+    "RegrowAborted", "RegrowHandle", "regrow_world", "commit_regrow",
+    "regrow_pending",
 ]
 
 _DEAD_HELP = "ranks currently marked dead and healed around"
@@ -615,7 +617,7 @@ def reset() -> None:
     records this module created via :func:`mark_rank_dead` are cleared
     too, so ``diagnostics.unhealthy_ranks()`` does not stay poisoned
     across a reset."""
-    global _pristine, _warned_send_scales
+    global _pristine, _warned_send_scales, _regrow_pending
     with _lock:
         forgotten = tuple(sorted(_dead))
         _dead.clear()
@@ -624,6 +626,8 @@ def reset() -> None:
         _warmup.clear()
         _pristine = None
         _warned_send_scales = False
+    _regrow_pending = None
+    _regrow_status.clear()
     if forgotten:
         _diag.clear_peer_failures(forgotten)
     _metrics.gauge("bluefog_dead_ranks", _DEAD_HELP).set(0)
@@ -757,3 +761,443 @@ def guard_step(fn: Callable, *, check_every_k: int = 1,
     ``metrics_every_k`` does (the probe compiles once, during warmup).
     """
     return GuardedStep(fn, check_every_k=check_every_k, depth=depth)
+
+
+# ---------------------------------------------------------------------------
+# Mesh regrowth: checkpoint-free world re-bootstrap
+# ---------------------------------------------------------------------------
+# Elastic membership (admit/retire above) works INSIDE the compiled world:
+# the mesh is frozen at bf.init, so a new physical rank can never join.
+# regrow_world is the jump across that boundary — quiesce, re-form the
+# mesh at N+K ranks (context.reinit), carry the survivors' state across in
+# host memory, and neighbor-pull-bootstrap the joiners on the NEW mesh.
+# No checkpoint round-trip anywhere.  Every phase gets a deadline +
+# bounded retry with exponential backoff, and a failed phase rolls the
+# process back to the old world (which is retained until the new world's
+# first step commits).
+
+#: regrow protocol phases, in execution order
+REGROW_PHASES = ("quiesce", "handshake", "snapshot", "reinit", "carry",
+                 "joiner_pull")
+
+_DEFAULT_REGROW_TIMEOUT = 30.0
+_DEFAULT_REGROW_RETRIES = 2
+
+_regrow_pending: Optional[Dict[str, Any]] = None
+_regrow_status: Dict[str, Any] = {}
+
+
+class RegrowAborted(RuntimeError):
+    """A mesh regrowth failed and was rolled back to the old world.
+
+    ``phase`` names the protocol phase that exhausted its deadline/retry
+    budget (or was killed), ``rank`` the blamed rank when a chaos kill
+    named one.  The process is back on the pre-regrowth mesh, schedules,
+    and membership registry: training and serving continue on the old
+    world — catching this exception IS the degraded-but-alive path.
+    """
+
+    def __init__(self, phase: str, reason: str,
+                 rank: Optional[int] = None):
+        self.phase = phase
+        self.reason = reason
+        self.rank = rank
+        super().__init__(
+            f"mesh regrowth aborted in phase {phase!r}: {reason}"
+            + (f" (blamed rank {rank})" if rank is not None else ""))
+
+
+class RegrowHandle:
+    """A regrowth that succeeded but is not yet committed.
+
+    The old world (context, compose carving, membership registry, and the
+    host snapshot of the carried state) stays retained until
+    :meth:`commit` — call it after the new world's *first* train/serve
+    step completes, so a blow-up on the very first post-regrowth step
+    still has a world to fall back to.
+    """
+
+    def __init__(self, *, world_before: int, world_after: int,
+                 coordinator: int, joiners: Tuple[int, ...],
+                 duration_s: float):
+        self.world_before = world_before
+        self.world_after = world_after
+        self.coordinator = coordinator
+        self.joiners = joiners
+        self.duration_s = duration_s
+
+    @property
+    def committed(self) -> bool:
+        return not regrow_pending()
+
+    def commit(self) -> bool:
+        return commit_regrow()
+
+    def __repr__(self) -> str:            # pragma: no cover - debug aid
+        state = "committed" if self.committed else "pending"
+        return (f"RegrowHandle({self.world_before}->{self.world_after}, "
+                f"coordinator={self.coordinator}, {state})")
+
+
+def _regrow_flight_block() -> Dict[str, Any]:
+    """The ``regrow`` bundle block ``tools/postmortem.py`` surfaces in the
+    verdict timeline (world sizes, coordinator, duration, aborts)."""
+    return dict(_regrow_status)
+
+
+def _publish_regrow(status: Dict[str, Any]) -> None:
+    _regrow_status.clear()
+    _regrow_status.update(status)
+
+
+def _regrow_timeout() -> float:
+    import os
+    env = os.environ.get("BLUEFOG_REGROW_TIMEOUT", "").strip()
+    if env:
+        t = float(env)
+        if t <= 0:
+            raise ValueError(
+                f"BLUEFOG_REGROW_TIMEOUT must be > 0, got {env!r}")
+        return t
+    return _DEFAULT_REGROW_TIMEOUT
+
+
+class _PhaseRunner:
+    """Deadline + bounded-retry executor for one regrow protocol phase.
+
+    Every attempt first gives the chaos plan its shot
+    (:func:`bluefog_tpu.utils.chaos.on_regrow_phase` — may kill the
+    coordinator/joiner or wedge the phase), then runs the phase body and
+    checks the elapsed time against the deadline.  A ``RankKilled`` is
+    never retried (the victim is gone; the caller aborts and rolls back);
+    any other failure — including a blown deadline — retries after
+    ``backoff * 2**(attempt-1)`` seconds up to ``retries`` times.
+    """
+
+    def __init__(self, *, status: Dict[str, Any], timeout: float,
+                 retries: int, backoff: float, coordinator: int,
+                 joiners: Tuple[int, ...]):
+        self.status = status
+        self.timeout = float(timeout)
+        self.retries = max(0, int(retries))
+        self.backoff = float(backoff)
+        self.coordinator = coordinator
+        self.joiners = joiners
+        self.phase = REGROW_PHASES[0]
+
+    def run(self, phase: str, fn: Callable[[], Any]) -> Any:
+        import time as _time
+
+        from .utils import chaos as _chaos
+        self.phase = phase
+        attempts = self.retries + 1
+        for attempt in range(1, attempts + 1):
+            t0 = _time.monotonic()
+            try:
+                _chaos.on_regrow_phase(
+                    phase, attempt, coordinator=self.coordinator,
+                    joiners=self.joiners)
+                out = fn()
+                elapsed = _time.monotonic() - t0
+                if elapsed > self.timeout:
+                    raise TimeoutError(
+                        f"phase {phase!r} attempt {attempt} took "
+                        f"{elapsed:.3f} s (deadline {self.timeout:.3f} s)")
+            except _chaos.RankKilled:
+                raise              # the victim is gone: abort, don't retry
+            except Exception as e:
+                elapsed = _time.monotonic() - t0
+                self.status["failed_attempts"] += 1
+                _flight.record(
+                    "regrow", name="phase_retry", phase=phase,
+                    attempt=attempt, elapsed_s=round(elapsed, 6),
+                    error=f"{type(e).__name__}: {e}")
+                _publish_regrow(self.status)
+                if attempt >= attempts:
+                    raise
+                _time.sleep(self.backoff * (2 ** (attempt - 1)))
+                continue
+            self.status["phases"].append(
+                {"phase": phase, "attempt": attempt,
+                 "elapsed_s": round(elapsed, 6)})
+            _publish_regrow(self.status)
+            _flight.record("regrow", name="phase", phase=phase,
+                           attempt=attempt, elapsed_s=round(elapsed, 6))
+            return out
+        raise AssertionError("unreachable")     # pragma: no cover
+
+
+def _snapshot_registry() -> Dict[str, Any]:
+    with _lock:
+        return {"dead": set(_dead), "retired": set(_retired),
+                "draining": set(_draining),
+                "warmup": {r: list(v) for r, v in _warmup.items()},
+                "pristine": _pristine}
+
+
+def _restore_registry(snap: Dict[str, Any]) -> None:
+    global _pristine
+    with _lock:
+        _dead.clear()
+        _dead.update(snap["dead"])
+        _retired.clear()
+        _retired.update(snap["retired"])
+        _draining.clear()
+        _draining.update(snap["draining"])
+        _warmup.clear()
+        _warmup.update({r: list(v) for r, v in snap["warmup"].items()})
+        _pristine = snap["pristine"]
+
+
+def _host_snapshot(tree: Any):
+    """Donation-safe host copy of a tree: jax leaves land as numpy arrays
+    (no device buffer is referenced afterwards), non-array leaves pass
+    through untouched."""
+    import jax
+    leaves, treedef = jax.tree.flatten(tree)
+    host = [(np.asarray(jax.device_get(leaf)), True)
+            if isinstance(leaf, jax.Array) else (leaf, False)
+            for leaf in leaves]
+    return treedef, host
+
+
+def _carry_state(snap, old_n: int, new_n: int, new_ctx) -> Any:
+    """Re-shard a host snapshot onto the regrown mesh.
+
+    Leaves with a leading rank axis (``shape[0] == old_n``) are expanded
+    (grow) or truncated (shrink) to ``new_n`` rows and distributed along
+    the new mesh's ``rank`` axis via ``jax.make_array_from_callback`` —
+    survivor rows byte-identical to the snapshot, joiner rows seeded from
+    rank 0's row as a finite placeholder the neighbor-pull bootstrap then
+    overwrites.  Everything else is replicated.
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    treedef, host = snap
+    mesh = new_ctx.mesh
+    row_sharding = NamedSharding(mesh, P("rank"))
+    rep_sharding = NamedSharding(mesh, P())
+    out = []
+    for arr, was_array in host:
+        if not was_array:
+            out.append(arr)
+            continue
+        if getattr(arr, "ndim", 0) >= 1 and arr.shape[0] == old_n:
+            full = np.empty((new_n,) + arr.shape[1:], arr.dtype)
+            rows = min(old_n, new_n)
+            full[:rows] = arr[:rows]
+            if new_n > old_n:
+                full[old_n:] = arr[0]
+            out.append(jax.make_array_from_callback(
+                full.shape, row_sharding,
+                lambda idx, a=full: a[idx]))
+        else:
+            out.append(jax.device_put(arr, rep_sharding))
+    return jax.tree.unflatten(treedef, out)
+
+
+def regrow_world(target: int, params: Any = None, *,
+                 coordinator: Optional[int] = None,
+                 timeout: Optional[float] = None,
+                 retries: int = _DEFAULT_REGROW_RETRIES,
+                 backoff: float = 0.05,
+                 min_neighbors: int = 2,
+                 warmup_steps: int = 0,
+                 topology_fn: Optional[Callable[[], nx.DiGraph]] = None,
+                 ) -> Tuple[Any, RegrowHandle]:
+    """Re-bootstrap the world at ``target`` ranks — no checkpoint files.
+
+    The supervisor-elected coordinator (lowest live rank by default)
+    drives the protocol::
+
+        quiesce ──► handshake ──► snapshot ──► reinit ──► carry ──► joiner_pull
+        (step        (coordinator  (params to   (new mesh   (re-shard  (bootstrap_
+         barrier)     election +    host mem,    + carving   onto new    params per
+                      validation)   donation-    + pristine  mesh)       joiner)
+                                    safe)        re-baseline)
+
+    Each phase runs under a deadline (``timeout``, default the
+    ``BLUEFOG_REGROW_TIMEOUT`` env var or 30 s) with ``retries`` bounded
+    retries and exponential backoff.  Any exhausted phase — or a chaos
+    ``kill_coordinator`` / ``kill_joiner`` — rolls the process back to the
+    old world (context, carving, membership registry) and raises
+    :class:`RegrowAborted`; survivors keep training/serving on the old
+    mesh.  On success the old world is *retained* until
+    :func:`commit_regrow` (call it after the new world's first step), and
+    ``(new_params, handle)`` is returned: survivor rows of ``params`` are
+    carried losslessly through host memory, joiner rows are seeded by the
+    PR 8 neighbor pull (:func:`bootstrap_params`) running on the new
+    mesh.  Previously-dead ranks stay healed around in the new world.
+
+    ``warmup_steps > 0`` opens the joiners' out-edges at reduced weight
+    that ramps to nominal over :func:`advance_membership` ticks, exactly
+    like an elastic re-admission.
+    """
+    global _regrow_pending
+    import time as _time
+
+    ctx = _mesh.get_context()
+    if _regrow_pending is not None:
+        raise RuntimeError(
+            "a regrowth is already pending; call commit_regrow() after "
+            "the new world's first step before regrowing again")
+    target = int(target)
+    old_n = ctx.size
+    if target < 2:
+        raise ValueError(f"regrow target must be >= 2, got {target}")
+    if target == old_n:
+        raise ValueError(
+            f"regrow target {target} equals the current world size")
+    if warmup_steps < 0:
+        raise ValueError("warmup_steps must be >= 0")
+    if timeout is None:
+        timeout = _regrow_timeout()
+    joiners = tuple(range(old_n, target)) if target > old_n else ()
+    if coordinator is None:
+        coordinator = min(live_ranks())
+    coordinator = int(coordinator)
+    if not (0 <= coordinator < old_n):
+        raise ValueError(
+            f"coordinator rank {coordinator} out of range for "
+            f"world size {old_n}")
+
+    capsule = {"ctx": ctx, "compose": _mesh.get_compose(),
+               "registry": _snapshot_registry()}
+    status: Dict[str, Any] = {
+        "world_before": old_n, "world_after": target,
+        "coordinator": coordinator, "joiners": list(joiners),
+        "committed": False, "failed_attempts": 0, "aborts": 0,
+        "phases": [], "duration_s": None,
+    }
+    _flight.register_block("regrow", _regrow_flight_block)
+    _publish_regrow(status)
+    _flight.record("regrow", name="begin", world_before=old_n,
+                   world_after=target, coordinator=coordinator,
+                   joiners=list(joiners))
+    runner = _PhaseRunner(status=status, timeout=timeout, retries=retries,
+                          backoff=backoff, coordinator=coordinator,
+                          joiners=joiners)
+    t_start = _time.monotonic()
+    try:
+        # 1. quiesce: step barrier — every rank's in-flight device work
+        # drains before the mesh is torn down under it
+        def _quiesce():
+            if params is not None:
+                import jax
+                jax.block_until_ready(params)
+        runner.run("quiesce", _quiesce)
+
+        # 2. handshake: the elected coordinator validates the target
+        # against the device pool before anything is torn down
+        def _handshake():
+            import jax
+            platform = getattr(ctx.devices[0], "platform", None)
+            pool = len(jax.devices(platform) if platform
+                       else jax.devices())
+            if target > pool:
+                raise ValueError(
+                    f"regrow target {target} exceeds the device pool "
+                    f"({pool})")
+            return coordinator
+        runner.run("handshake", _handshake)
+
+        # 3. snapshot: carried state to host memory (donation-safe — no
+        # device buffer referenced past this point)
+        snap = runner.run(
+            "snapshot",
+            (lambda: _host_snapshot(params)) if params is not None
+            else (lambda: None))
+
+        # 4. reinit: tear down + re-form mesh/carving/registry at target
+        new_ctx = runner.run(
+            "reinit",
+            lambda: _mesh.reinit(target, topology_fn=topology_fn))
+
+        # previously-dead ranks stay healed around in the new world
+        carried_dead = sorted(r for r in capsule["registry"]["dead"]
+                              if r < target)
+        if carried_dead:
+            mark_rank_dead(*carried_dead)
+
+        # 5. carry: survivors' rows re-shard onto the new mesh
+        new_params = None
+        if params is not None:
+            new_params = runner.run(
+                "carry",
+                lambda: _carry_state(snap, old_n, target, new_ctx))
+
+        # 6. joiner pull: bootstrap each joiner by live neighbor gossip
+        # on the NEW mesh, then open its out-edges (optionally ramped)
+        if joiners:
+            def _pull():
+                out = new_params
+                if out is not None:
+                    for j in joiners:
+                        out = bootstrap_params(
+                            out, j, min_neighbors=min_neighbors)
+                if warmup_steps:
+                    _refresh_pristine(new_ctx)
+                    with _lock:
+                        for j in joiners:
+                            _warmup[j] = [1, warmup_steps + 1]
+                    _apply_membership(new_ctx)
+                return out
+            new_params = runner.run("joiner_pull", _pull)
+    except Exception as exc:
+        status["aborts"] += 1
+        rank = getattr(exc, "rank", None)
+        _mesh._install(capsule["ctx"], capsule["compose"])
+        _restore_registry(capsule["registry"])
+        _publish_regrow(status)
+        _flight.record("regrow", name="abort", phase=runner.phase,
+                       world_before=old_n, world_after=target,
+                       coordinator=coordinator, rank=rank,
+                       error=f"{type(exc).__name__}: {exc}")
+        _fault_span(f"resilience:regrow_abort:{runner.phase}")
+        raise RegrowAborted(
+            runner.phase, f"{type(exc).__name__}: {exc}",
+            rank=rank) from exc
+
+    duration = _time.monotonic() - t_start
+    status["duration_s"] = round(duration, 6)
+    _regrow_pending = {"capsule": capsule, "status": status}
+    _publish_regrow(status)
+    _update_membership_gauges(target)
+    _count_membership("regrow")
+    _flight.record("regrow", name="regrown", world_before=old_n,
+                   world_after=target, coordinator=coordinator,
+                   joiners=list(joiners), duration_s=round(duration, 6))
+    handle = RegrowHandle(
+        world_before=old_n, world_after=target, coordinator=coordinator,
+        joiners=joiners, duration_s=duration)
+    return new_params, handle
+
+
+def commit_regrow() -> bool:
+    """Release the old world after a successful regrowth.
+
+    Call after the new world's first train/serve step completes: until
+    then the pre-regrowth context, carving, registry snapshot, and host
+    state snapshot are all retained so a first-step blow-up can still
+    roll back by hand.  Returns True if a pending regrowth was committed,
+    False when none was pending.  Idempotent.
+    """
+    global _regrow_pending
+    if _regrow_pending is None:
+        return False
+    status = _regrow_pending["status"]
+    _regrow_pending = None
+    status["committed"] = True
+    _publish_regrow(status)
+    _flight.record("regrow", name="commit",
+                   world_before=status["world_before"],
+                   world_after=status["world_after"],
+                   coordinator=status["coordinator"],
+                   duration_s=status["duration_s"])
+    return True
+
+
+def regrow_pending() -> bool:
+    """True while a regrowth awaits :func:`commit_regrow`."""
+    return _regrow_pending is not None

@@ -399,12 +399,41 @@ def _write_scale(path: str, target: int) -> None:
     os.replace(tmp, path)      # atomic: the supervisor never reads a torn file
 
 
-def _read_scale(path: str) -> Optional[int]:
+_warned_scale: set = set()
+
+
+def _read_scale(path: str, min_world: Optional[int] = None) -> Optional[int]:
+    """Read the elastic scale target; ``None`` when absent or unusable.
+
+    A missing file is the normal idle state (silent), but a *malformed*
+    file or a target below ``min_world`` means a hand-written target is
+    silently disabling elastic scaling — warn once per offending content
+    naming the path, so the operator can fix it.
+    """
     try:
         with open(path) as f:
-            return int(f.read().strip())
-    except (OSError, ValueError):
+            raw = f.read().strip()
+    except OSError:
         return None
+    try:
+        target = int(raw)
+    except ValueError:
+        key = (path, raw)
+        if key not in _warned_scale:
+            _warned_scale.add(key)
+            print(f"bfrun-tpu: ignoring malformed scale file {path}: "
+                  f"expected an integer target, got {raw!r}",
+                  file=sys.stderr, flush=True)
+        return None
+    if min_world is not None and target < min_world:
+        key = (path, raw)
+        if key not in _warned_scale:
+            _warned_scale.add(key)
+            print(f"bfrun-tpu: ignoring scale file {path}: target "
+                  f"{target} is below the minimum world size {min_world}",
+                  file=sys.stderr, flush=True)
+        return None
+    return target
 
 
 def _report_flight_bundles(flight_dir, say) -> None:
@@ -478,8 +507,8 @@ def _supervise_procs(procs, respawn=None, *, restart_limit=0,
 
     while True:
         if elastic and scale_file and spawn is not None:
-            target = _read_scale(scale_file)
-            if target is not None and target > 0 and target != applied_target:
+            target = _read_scale(scale_file, min_world=1)
+            if target is not None and target != applied_target:
                 applied_target = target
                 slots = len(procs) - len(retiring)
                 while slots < target:

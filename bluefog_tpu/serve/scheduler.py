@@ -49,7 +49,7 @@ from ..utils import metrics as _metrics
 from .engine import ServeEngine
 from .kv_cache import PrefixCache, SlotAllocator
 
-__all__ = ["Request", "Scheduler"]
+__all__ = ["Request", "Scheduler", "AutoScaler"]
 
 LATENCY_BUCKETS = (.0005, .001, .0025, .005, .01, .025, .05, .1, .25, .5,
                    1.0, 2.5)
@@ -70,6 +70,7 @@ class Request:
     submitted_at: float = 0.0
     first_token_at: Optional[float] = None
     finished_at: Optional[float] = None
+    requeued: int = 0                # replica-failure evictions survived
 
     @property
     def next_pos(self) -> int:
@@ -105,6 +106,7 @@ class Scheduler:
         self._last_ids: List[List[int]] = [[] for _ in range(self.replicas)]
         self.completed: List[Request] = []
         self.failed: List[Request] = []
+        self.requeued_total = 0
         _flight.register_block("serve", self._flight_block)
 
     # ------------------------------------------------------------------
@@ -139,32 +141,55 @@ class Scheduler:
 
     # ------------------------------------------------------------------
 
-    def fail_replica(self, replica: int) -> List[Request]:
-        """Take a replica out of rotation (chaos kill / health eviction).
+    def fail_replica(self, replica: int,
+                     reason: str = "failed") -> List[Request]:
+        """Take a replica out of rotation (chaos kill / health eviction /
+        autoscale retire).
 
-        Its in-flight requests fail (their KV — and any shared prefix
-        pages — lived on the dead slice); queued requests are untouched
-        and will admit onto survivors, re-sealing prefixes there on
-        first miss.
+        Its in-flight requests are NOT lost: their KV — and any shared
+        prefix pages — lived on the dead slice, so each one is reset to
+        its prompt and requeued at the HEAD of the admission queue (it
+        already waited its turn once) with ``requeued`` stamped into the
+        request and ``bluefog_requests_total{status="requeued"}``
+        counted.  They re-prefill on a survivor at the next admit;
+        queued requests behind them are untouched.
         """
         if replica in self._dead:
             return []
         self._dead.add(replica)
         lost = list(self._active[replica].values())
         for req in lost:
-            req.state = "failed"
-            req.finished_at = time.monotonic()
             self._alloc[replica].free(req.slot)
-            self.failed.append(req)
+            if req.prefix_row >= 0 and self._prefix[replica] is not None:
+                self._prefix[replica].release(req.prefix_row)
+            req.state = "queued"
+            req.replica = req.slot = req.prefix_row = -1
+            req.prefix_len = 0
+            req.generated.clear()          # KV died with the replica
+            req.first_token_at = None
+            req.requeued += 1
+            self.requeued_total += 1
             _metrics.counter(
                 "bluefog_requests_total",
-                "serve requests by terminal status").inc(status="failed")
+                "serve requests by terminal status").inc(status="requeued")
         self._active[replica].clear()
-        _flight.record("serve", name="replica_failed", replica=replica,
-                       lost_requests=[r.id for r in lost])
+        # head requeue, original arrival order preserved among the evicted
+        self._queue.extendleft(reversed(lost))
+        _flight.record("serve", name=f"replica_{reason}", replica=replica,
+                       requeued_requests=[r.id for r in lost])
         if not self.live_replicas():
             raise RuntimeError("every serving replica has failed")
         return lost
+
+    def restore_replica(self, replica: int) -> bool:
+        """Bring a previously-failed replica back into rotation (the
+        autoscale grow path: a parked reserve replica re-admits traffic).
+        Returns True if the replica was dead."""
+        if replica not in self._dead:
+            return False
+        self._dead.discard(replica)
+        _flight.record("serve", name="replica_restored", replica=replica)
+        return True
 
     # ------------------------------------------------------------------
 
@@ -360,6 +385,7 @@ class Scheduler:
                                  in enumerate(self._last_ids) if ids},
             "completed": len(self.completed),
             "failed": [r.id for r in self.failed],
+            "requeued": self.requeued_total,
         }
         if self._prefix[0] is not None:
             block["prefix_pages"] = {
@@ -369,3 +395,116 @@ class Scheduler:
 
     def close(self) -> None:
         _flight.unregister_block("serve")
+
+
+class AutoScaler:
+    """SLO-driven serve autoscaling: breaches write the scale file.
+
+    Watches two signals after every :meth:`Scheduler.step` — the
+    admission-queue depth and an EWMA of the p99 of the existing
+    ``bluefog_serve_token_latency_seconds`` histogram — and closes the
+    elastic loop: a sustained breach *grows* the serving fleet (restores
+    the lowest parked/dead replica AND writes ``target`` into the bfrun
+    scale file so the supervisor regrows the world under it), a quiet
+    queue well under the SLO *retires* the highest live replica after a
+    cooldown.  Retirement uses the requeue path, so shrinking never fails
+    a request.
+
+    Knobs (env defaults): ``BLUEFOG_AUTOSCALE`` gates
+    :meth:`enabled_from_env`; ``BLUEFOG_SLO_P99_MS`` sets the p99 target
+    (default 250 ms).  ``cooldown_steps`` applies between any two scale
+    actions in either direction.
+    """
+
+    def __init__(self, sched: Scheduler, *,
+                 slo_p99_s: Optional[float] = None,
+                 queue_high: Optional[int] = None,
+                 cooldown_steps: int = 50,
+                 scale_file: Optional[str] = None,
+                 min_replicas: int = 1,
+                 alpha: float = 0.2):
+        from ..utils.config import env_float
+        if slo_p99_s is None:
+            slo_p99_s = env_float("BLUEFOG_SLO_P99_MS", 250.0) / 1000.0
+        if slo_p99_s <= 0:
+            raise ValueError(f"slo_p99_s must be > 0, got {slo_p99_s}")
+        if queue_high is None:
+            # headroom of one full refill of every live replica's slots
+            queue_high = 2 * sched.engine.scfg.slots * max(
+                1, len(sched.live_replicas()))
+        self.sched = sched
+        self.slo_p99_s = float(slo_p99_s)
+        self.queue_high = int(queue_high)
+        self.cooldown_steps = int(cooldown_steps)
+        self.scale_file = scale_file
+        self.min_replicas = max(1, int(min_replicas))
+        self.alpha = float(alpha)
+        self.ewma_p99: Optional[float] = None
+        self.events: List[dict] = []
+        self._step = 0
+        self._last_action_step = -cooldown_steps
+
+    @staticmethod
+    def enabled_from_env() -> bool:
+        from ..utils.config import env_flag
+        return env_flag("BLUEFOG_AUTOSCALE", False)
+
+    # ------------------------------------------------------------------
+
+    def _write_scale(self, target: int) -> None:
+        if self.scale_file is None:
+            return
+        from ..run.launcher import _write_scale
+        _write_scale(self.scale_file, target)
+
+    def _record(self, action: str, replica: int) -> None:
+        live = len(self.sched.live_replicas())
+        ev = {"step": self._step, "action": action, "replica": replica,
+              "live_replicas": live,
+              "pending": self.sched.pending,
+              "ewma_p99_s": self.ewma_p99}
+        self.events.append(ev)
+        self._last_action_step = self._step
+        self._write_scale(live)
+        _metrics.counter(
+            "bluefog_autoscale_events_total",
+            "autoscale actions by direction").inc(action=action)
+        _flight.record("autoscale", name=action, replica=replica,
+                       live_replicas=live, pending=self.sched.pending,
+                       ewma_p99_s=self.ewma_p99)
+
+    # ------------------------------------------------------------------
+
+    def observe(self) -> Optional[dict]:
+        """Fold in one scheduler step; returns the scale event if one
+        fired.  Call once per :meth:`Scheduler.step`."""
+        self._step += 1
+        p99 = _metrics.histogram(
+            "bluefog_serve_token_latency_seconds",
+            "per-token serve latency (prefill + decode)",
+            buckets=LATENCY_BUCKETS).percentile(99)
+        if p99 is not None:
+            self.ewma_p99 = (p99 if self.ewma_p99 is None else
+                             self.alpha * p99
+                             + (1.0 - self.alpha) * self.ewma_p99)
+        if self._step - self._last_action_step < self.cooldown_steps:
+            return None
+        sched = self.sched
+        breach = (sched.pending > self.queue_high
+                  or (self.ewma_p99 is not None
+                      and self.ewma_p99 > self.slo_p99_s))
+        if breach and sched._dead:
+            replica = min(sched._dead)
+            sched.restore_replica(replica)
+            self._record("grow", replica)
+            return self.events[-1]
+        live = sched.live_replicas()
+        calm = (not breach and sched.pending == 0
+                and (self.ewma_p99 is None
+                     or self.ewma_p99 < 0.5 * self.slo_p99_s))
+        if calm and len(live) > self.min_replicas:
+            replica = max(live)
+            sched.fail_replica(replica, reason="retired")
+            self._record("retire", replica)
+            return self.events[-1]
+        return None

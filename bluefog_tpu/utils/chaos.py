@@ -33,6 +33,14 @@ Fault kinds (reference failure modes they emulate):
   step outputs, then ``admit_rank`` with ``warmup=`` ramp steps), so
   membership churn is seeded-deterministic and testable.  No-op if the
   rank is already live.
+- ``kill_coordinator`` / ``kill_joiner`` / ``hang_reinit`` — hostile
+  scale events for the mesh-regrowth protocol
+  (``resilience.regrow_world``): kill the elected coordinator during a
+  coordinator-driven phase, kill a joining rank mid-bootstrap, or wedge
+  the re-init phase for ``t`` seconds.  Matched by ``step=`` / ``p=``
+  against the per-phase *attempt* counter via :func:`on_regrow_phase`;
+  the expected outcome is a clean abort that leaves the old world
+  training/serving.
 
 Matching sites: faults with ``op=``/``call=`` match eager op dispatches
 (``api.py`` / ``parallel/windows.py``); all others match the train-step
@@ -60,13 +68,31 @@ __all__ = [
     "Fault", "ChaosPlan", "RankKilled",
     "install", "uninstall", "active", "current_plan",
     "maybe_install_from_env", "on_train_step", "corrupt_train_output",
-    "apply_membership", "on_eager_op", "consume_step_delays",
+    "apply_membership", "on_eager_op", "on_regrow_phase",
+    "consume_step_delays",
 ]
 
 ENV_VAR = "BLUEFOG_CHAOS"
 DEFAULT_KILL_CODE = 43
 
-_KINDS = ("kill", "hang", "throttle", "nan", "join")
+_KINDS = ("kill", "hang", "throttle", "nan", "join",
+          "kill_coordinator", "kill_joiner", "hang_reinit")
+
+#: Fault kinds that fire inside the mesh-regrowth protocol (matched by
+#: :func:`on_regrow_phase` against the per-phase attempt counter, never by
+#: the train-step / eager-op hooks).  ``kill_coordinator`` kills the
+#: elected coordinator during a coordinator-driven phase (quiesce /
+#: handshake / reinit), ``kill_joiner`` kills a joining rank during its
+#: bootstrap pull, ``hang_reinit`` wedges the re-init phase for ``t``
+#: seconds (the deadline + retry machinery is the detector).
+_REGROW_KINDS = ("kill_coordinator", "kill_joiner", "hang_reinit")
+
+#: regrow phases each regrow fault kind can fire in
+_REGROW_PHASES = {
+    "kill_coordinator": ("quiesce", "handshake", "reinit"),
+    "kill_joiner": ("joiner_pull",),
+    "hang_reinit": ("reinit",),
+}
 
 
 class RankKilled(RuntimeError):
@@ -107,7 +133,7 @@ class Fault:
             raise ValueError(
                 f"unknown chaos fault kind {self.kind!r} (expected one of "
                 f"{_KINDS})")
-        if self.kind in ("hang", "throttle") and self.t <= 0:
+        if self.kind in ("hang", "throttle", "hang_reinit") and self.t <= 0:
             raise ValueError(f"{self.kind} fault needs t=<seconds> > 0")
         if self.kind in ("nan", "join") and self.rank is None:
             raise ValueError(f"{self.kind} fault needs rank=<target rank>")
@@ -115,6 +141,11 @@ class Fault:
                                     or self.call is not None):
             raise ValueError(
                 "join faults match train steps, not eager ops (no op=/call=)")
+        if self.kind in _REGROW_KINDS and (self.op is not None
+                                           or self.call is not None):
+            raise ValueError(
+                f"{self.kind} faults match regrow-phase attempts, not "
+                "eager ops (no op=/call=)")
         if self.warmup < 0:
             raise ValueError(f"warmup must be >= 0, got {self.warmup}")
         if (self.step is None and self.call is None and self.p is None
@@ -192,10 +223,29 @@ class ChaosPlan:
             f"{self.seed}:{fault_index}:{fault.kind}:{tick}").random()
         return r < fault.p  # type: ignore[operator]
 
+    def match_regrow(self, phase: str, attempt: int) -> List[Fault]:
+        """Regrow faults armed for this protocol phase + attempt.  The
+        attempt counter plays the role ``step`` plays for train-step
+        faults: ``kill_coordinator:step=1`` fires on the first attempt of
+        a coordinator phase, ``hang_reinit:p=1.0,t=2`` wedges every
+        re-init attempt until the deadline budget aborts the regrowth."""
+        out = []
+        for i, f in enumerate(self.faults):
+            if f.kind not in _REGROW_KINDS:
+                continue
+            if phase not in _REGROW_PHASES[f.kind]:
+                continue
+            if f.step is not None and f.step == attempt:
+                out.append(f)
+            elif (f.step is None and f.p is not None
+                  and self._draw(i, f, attempt)):
+                out.append(f)
+        return out
+
     def match_step(self, step: int) -> List[Fault]:
         out = []
         for i, f in enumerate(self.faults):
-            if f.is_op_fault:
+            if f.is_op_fault or f.kind in _REGROW_KINDS:
                 continue
             if f.kind == "throttle":
                 start = f.step if f.step is not None else 1
@@ -280,11 +330,12 @@ def maybe_install_from_env() -> bool:
 # ---------------------------------------------------------------------------
 
 def _record_fault(fault: Fault, site: str, dur_s: float = 0.0,
-                  tick: Optional[int] = None) -> None:
+                  tick: Optional[int] = None,
+                  rank: Optional[int] = None) -> None:
     try:
         from . import flight as _flight
         _flight.record("chaos", name=f"{fault.kind}:{site}", step=tick,
-                       rank=fault.rank, t=fault.t)
+                       rank=fault.rank if rank is None else rank, t=fault.t)
     except Exception:                                      # pragma: no cover
         pass
     try:
@@ -463,3 +514,37 @@ def on_eager_op(op_name: str, out):
         else:
             _enact(f, op_name, call)
     return out
+
+
+def on_regrow_phase(phase: str, attempt: int, *,
+                    coordinator: Optional[int] = None,
+                    joiners: Tuple[int, ...] = ()) -> None:
+    """Mesh-regrowth protocol hook, called by
+    :func:`bluefog_tpu.resilience.regrow_world` at the top of every phase
+    attempt.  May raise :class:`RankKilled` (``kill_coordinator`` /
+    ``kill_joiner`` — the regrowth aborts and rolls back to the old world)
+    or sleep (``hang_reinit`` — the phase deadline is the detector).
+
+    ``kill_joiner`` without an explicit ``rank=`` kills the first joiner;
+    with ``rank=`` it fires only when that rank is actually joining, so a
+    plan written for one drill cannot stray into another."""
+    plan = _plan
+    if plan is None:
+        return
+    site = f"regrow_{phase}"
+    for f in plan.match_regrow(phase, attempt):
+        if f.kind == "kill_coordinator":
+            victim = coordinator if f.rank is None else f.rank
+            _record_fault(f, site, tick=attempt, rank=victim)
+            raise RankKilled(victim, attempt, f.code)
+        if f.kind == "kill_joiner":
+            if f.rank is not None and f.rank not in joiners:
+                continue
+            victim = f.rank if f.rank is not None else (
+                joiners[0] if joiners else None)
+            _record_fault(f, site, tick=attempt, rank=victim)
+            raise RankKilled(victim, attempt, f.code)
+        if f.kind == "hang_reinit":
+            _record_fault(f, site, dur_s=f.t, tick=attempt)
+            time.sleep(f.t)
+            _attribute_delay(f.rank, f.t)

@@ -334,6 +334,81 @@ def test_restart_limit_exhausted_fails_with_count(capsys):
     assert "job failed: rank 0 exited with code 7 after 1 restart(s)" in err
 
 
+def test_restart_backoff_schedule_pinned(monkeypatch, capsys):
+    """The restart backoff is exponential with deterministic seeded
+    jitter: attempt a sleeps ``backoff * 2**(a-1)`` scaled by +0..25 %
+    from ``random.Random(f"bfrun:{rank}:{a}")`` — pin the exact schedule
+    (reported to 2 decimals in the restart line) and the exhaustion
+    message naming the rank and exit code."""
+    import random
+    import sys
+    import time
+
+    base_backoff = 0.5
+    slept = []
+    real_sleep = time.sleep
+    monkeypatch.setattr(
+        time, "sleep",
+        lambda d: slept.append(d) if d >= base_backoff else real_sleep(d))
+    code = launcher.main(
+        ["-np", "1", "--restart-limit", "3",
+         "--restart-backoff", str(base_backoff),
+         "--", sys.executable, "-c", "import sys; sys.exit(7)"])
+    assert code == 7
+    err = capsys.readouterr().err
+    expected = []
+    for attempt in (1, 2, 3):
+        base = base_backoff * (2 ** (attempt - 1))
+        delay = base * (
+            1.0 + 0.25 * random.Random(f"bfrun:0:{attempt}").random())
+        assert base <= delay <= base * 1.25
+        expected.append(delay)
+        assert (f"restarting rank 0 (attempt {attempt}/3) "
+                f"after {delay:.2f} s backoff") in err
+    assert slept == pytest.approx(expected)
+    assert "job failed: rank 0 exited with code 7 after 3 restart(s)" in err
+
+
+def test_read_scale_warns_once_on_malformed(tmp_path, capsys):
+    """A malformed scale file silently disables elastic scaling unless we
+    tell the operator — warn exactly once per offending content, naming
+    the path and what was found."""
+    launcher._warned_scale.clear()
+    scale = tmp_path / "scale"
+    scale.write_text("six\n")
+    assert launcher._read_scale(str(scale)) is None
+    assert launcher._read_scale(str(scale)) is None
+    err = capsys.readouterr().err
+    assert err.count("malformed scale file") == 1
+    assert str(scale) in err
+    assert "'six'" in err
+    # new offending content warns again (it is a different mistake)
+    scale.write_text("7.5")
+    assert launcher._read_scale(str(scale)) is None
+    assert "'7.5'" in capsys.readouterr().err
+    # a missing file is the normal idle state: silent
+    assert launcher._read_scale(str(tmp_path / "absent")) is None
+    assert capsys.readouterr().err == ""
+    launcher._warned_scale.clear()
+
+
+def test_read_scale_warns_once_below_minimum(tmp_path, capsys):
+    launcher._warned_scale.clear()
+    scale = tmp_path / "scale"
+    scale.write_text("0")
+    assert launcher._read_scale(str(scale), min_world=1) is None
+    assert launcher._read_scale(str(scale), min_world=1) is None
+    err = capsys.readouterr().err
+    assert err.count(
+        "target 0 is below the minimum world size 1") == 1
+    assert str(scale) in err
+    # a valid target reads clean, no warning
+    scale.write_text("3")
+    assert launcher._read_scale(str(scale), min_world=1) == 3
+    assert capsys.readouterr().err == ""
+    launcher._warned_scale.clear()
+
+
 def test_multihost_restart_respawns_remote_argv(tmp_path, capsys):
     """-H fan-out honors --restart-limit too: the dead rank's ssh argv is
     respawned verbatim while the survivor keeps running."""

@@ -473,10 +473,11 @@ def test_e2e_serving_while_training_advances(cpu_devices):
 
 def test_chaos_drill_kill_serving_replica(cpu_devices, tmp_path):
     """A serving replica dies mid-stream (chaos kill on its lead rank):
-    the survivors complete every surviving request, the refresher keeps
-    pulling through the healed topology, and the flight bundle +
+    its in-flight requests requeue at the head of the queue and EVERY
+    request completes on the survivors — zero failures — the refresher
+    keeps pulling through the healed topology, and the flight bundle +
     postmortem blame the right rank, with the serve block carrying the
-    lost request ids."""
+    requeued count."""
     cfg, train_m, (step, state, train_params, toks), eng = \
         _estate(cpu_devices)
     refresher = WeightRefresher(eng, train_m, every=2)
@@ -506,13 +507,21 @@ def test_chaos_drill_kill_serving_replica(cpu_devices, tmp_path):
     bfchaos.uninstall()
 
     assert sorted(r.id for r in lost) == sorted(r.id for r in victims)
+    # evicted requests went to the HEAD of the queue, stamped as requeued
+    assert all(r.state == "queued" and r.requeued == 1 for r in lost)
+    assert [r.id for r in list(sched._queue)[:len(lost)]] == \
+        [r.id for r in lost]
+    assert sched.requeued_total == len(lost)
+    assert bfm.counter("bluefog_requests_total").value(
+        status="requeued") == len(lost)
     sched.drain()
-    assert len(sched.completed) + len(sched.failed) == 8
-    assert sched.failed and all(r.replica == dead_replica
-                                for r in sched.failed)
+    # zero failed requests across the event: the victims re-ran on the
+    # survivor and every request completed in full
+    assert len(sched.completed) == 8 and not sched.failed
     assert all(r.replica == 0 for r in sched.completed)
     assert all(len(r.generated) == r.max_new_tokens
                for r in sched.completed)
+    assert all(r.requeued == 1 for r in lost)
 
     refresher.pull(train_params, train_done)      # healed topology pulls
     assert refresher.staleness() == 0.0
@@ -522,15 +531,14 @@ def test_chaos_drill_kill_serving_replica(cpu_devices, tmp_path):
     bundle = json.loads(bundle_path.read_text())
     sv = bundle["serve"]
     assert sv["dead_replicas"] == [dead_replica]
-    assert sorted(sv["failed"]) == sorted(r.id for r in lost)
+    assert sv["failed"] == [] and sv["requeued"] == len(lost)
     assert sv["last_request_ids"]["0"], sv
 
     pm = _load_tool("tools/postmortem")
     report = pm.analyze({0: bundle})
     assert report["verdict"]["first_failed_rank"] == dead_rank
     assert report["serve"]["dead_replicas"] == [dead_replica]
-    assert sorted(report["serve"]["failed_request_ids"]) == \
-        sorted(r.id for r in lost)
+    assert report["serve"]["failed_request_ids"] == []
     sched.close()
 
 

@@ -233,6 +233,16 @@ def _battery_steps(tag: str, stage: int = 0) -> list:
                        "--out",
                        os.path.join(m, f"serve_bench_fast_{tag}.json")],
                       2400, None, None))
+        # the scale-event row: bursty flash-crowd traffic with a parked
+        # reserve replica — the autoscaler must grow into the spike and
+        # the schema-3 trace row demands zero failed requests + SLO
+        # recovery under the bound on real hardware too
+        steps.append(("serve_bench_trace",
+                      [py, sb, "--train-dp", "2", "--serve-dp", "2",
+                       "--pp", "2", "--traffic-trace", "flash-crowd",
+                       "--out",
+                       os.path.join(m, f"serve_bench_trace_{tag}.json")],
+                      2400, None, None))
     # the async-gossip headline: one rank throttled 10x on the real mesh,
     # async wall-clock-to-consensus vs lockstep on the same push schedule
     # (cheap: two small-strategy compiles, tens of gossip ticks)
@@ -317,6 +327,11 @@ def _rehearsal_steps(tag: str) -> list:
           "--virtual-cpu", "--smoke", "--spec-decode", "3@1",
           "--kv-dtype", "int8", "--prefix-pages", "2x8",
           "--out", os.path.join(m, f"serve_bench_fast_{tag}.json")], 900,
+         None, None),
+        ("serve_bench_trace",
+         [py, os.path.join(REPO, "tools", "serve_bench.py"),
+          "--virtual-cpu", "--smoke", "--traffic-trace", "flash-crowd",
+          "--out", os.path.join(m, f"serve_bench_trace_{tag}.json")], 900,
          None, None),
         ("async_frontier",
          [py, os.path.join(REPO, "tools", "gossip_bench.py"),

@@ -25,6 +25,10 @@ job look like on the way down*:
                    lost with a killed replica — chaos kills name their
                    victim rank in the event, and the verdict blames that
                    rank even when another process recorded the kill
+    regrow         (after a mesh-regrowth scale event) world sizes
+                   before/after, coordinator rank, regrowth duration,
+                   aborted attempts, and the protocol-phase timeline —
+                   from the ``regrow`` bundle block + ``regrow`` events
 
 Torn bundles (a rank killed mid-write) are skipped with a warning, never
 fatal — same contract as ``tools/metrics_report.py`` with truncated JSONL.
@@ -40,6 +44,9 @@ Output schema (stable, pinned by tests/test_flight.py and
      "step_time": {"mean_s", "skew_s", "straggler_rank"},
      "consensus": [[step, max_distance], ...], "topology": {...},
      "serve": {...} (only when a bundle carries a serve block),
+     "regrow": {...} (only when a bundle saw a mesh-regrowth scale event:
+     world sizes before/after, coordinator rank, duration, aborted
+     attempts, and the step-ordered protocol timeline),
      "notes": [str, ...]}
 """
 import argparse
@@ -239,6 +246,59 @@ def _serve_block(bundles, notes):
     }
 
 
+def _regrow_block(bundles, notes):
+    """Surface scale events in the verdict timeline: world sizes
+    before/after, coordinator rank, regrowth duration, aborted attempts —
+    from the bundles' ``regrow`` blocks plus every ``regrow``-kind event
+    (begin / phase / phase_retry / abort / regrown / commit), merged and
+    step-ordered.  Present only when a bundle saw a scale event."""
+    merged = {}
+    timeline = []
+    for rank in sorted(bundles):
+        rg = bundles[rank].get("regrow")
+        if isinstance(rg, dict):
+            if "error" in rg:
+                notes.append(f"rank {rank}: regrow block provider failed: "
+                             f"{rg['error']}")
+            elif rg:
+                merged[str(rank)] = rg
+        for ev in bundles[rank].get("events", ()):
+            if ev.get("kind") != "regrow":
+                continue
+            entry = {k: v for k, v in ev.items()
+                     if k not in ("kind",) and v is not None}
+            entry["bundle_rank"] = rank
+            timeline.append(entry)
+    if not merged and not timeline:
+        return None
+    timeline.sort(key=lambda e: e.get("ts") or 0)
+    out = {"per_bundle": merged, "timeline": timeline}
+    # headline fields from the newest per-bundle status (single-process
+    # sims carry one; multi-process fleets agree on the coordinator's)
+    if merged:
+        newest = max(merged.values(),
+                     key=lambda rg: (rg.get("committed", False),
+                                     len(rg.get("phases", ()))))
+        for key in ("world_before", "world_after", "coordinator",
+                    "duration_s", "committed"):
+            if key in newest:
+                out[key] = newest[key]
+        out["aborted_attempts"] = newest.get("failed_attempts", 0)
+        out["aborts"] = newest.get("aborts", 0)
+        if newest.get("committed"):
+            notes.append(
+                "world regrew %s -> %s (coordinator rank %s, %.3g s)"
+                % (newest.get("world_before"), newest.get("world_after"),
+                   newest.get("coordinator"),
+                   newest.get("duration_s") or 0.0))
+        elif newest.get("aborts"):
+            notes.append(
+                "a regrowth %s -> %s ABORTED and rolled back to the old "
+                "world" % (newest.get("world_before"),
+                           newest.get("world_after")))
+    return out
+
+
 def analyze(bundles, notes=None, torn=()):
     """``{rank: bundle}`` -> postmortem report dict."""
     notes = notes if notes is not None else []
@@ -304,6 +364,9 @@ def analyze(bundles, notes=None, torn=()):
     serve = _serve_block(bundles, notes)
     if serve is not None:
         report["serve"] = serve
+    regrow = _regrow_block(bundles, notes)
+    if regrow is not None:
+        report["regrow"] = regrow
     if notes:
         report["notes"] = notes
     return report

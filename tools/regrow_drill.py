@@ -1,0 +1,137 @@
+"""Subprocess mesh-regrowth drill: grow a live world by K ranks, no files.
+
+The ``make regrow-smoke`` companion to ``tools/serve_bench.py
+--traffic-trace``: boots a virtual-CPU gossip world of ``--world`` ranks,
+trains it a couple of neighbor-averaging steps, then drives the full
+:func:`bluefog_tpu.resilience.regrow_world` protocol to ``--target``
+ranks — quiesce, coordinator handshake, host snapshot, mesh re-init,
+state carry, joiner neighbor-pull — takes one step on the NEW world, and
+only then commits (releasing the old world).  With ``--chaos`` the same
+drill proves the abort path instead: the injected
+``kill_coordinator``/``kill_joiner``/``hang_reinit`` fault must roll the
+process back to the OLD world, which then demonstrates it can still
+step.
+
+Writes a flight bundle into ``--flight-dir`` (the ``regrow`` block +
+event timeline ``tools/postmortem.py`` surfaces in its verdict) and
+prints a one-line JSON artifact on stdout (last line)::
+
+    {"schema": "bluefog-regrow-drill-1", "ok": true, "world_before": 4,
+     "world_after": 6, "committed": true, "aborted": false, ...}
+
+Run:   python tools/regrow_drill.py --virtual-cpu 8 --world 4 --target 6 \
+           --flight-dir /tmp/regrow_flight
+Abort: python tools/regrow_drill.py --virtual-cpu 8 --world 4 --target 6 \
+           --chaos "kill_coordinator:step=1" --flight-dir /tmp/rg
+"""
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, REPO)
+
+SCHEMA = "bluefog-regrow-drill-1"
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--virtual-cpu", type=int, default=8,
+                    help="virtual CPU device pool (must cover --target)")
+    ap.add_argument("--world", type=int, default=4,
+                    help="initial world size")
+    ap.add_argument("--target", type=int, default=6,
+                    help="regrown world size")
+    ap.add_argument("--steps", type=int, default=2,
+                    help="gossip steps before the regrowth")
+    ap.add_argument("--warmup-steps", type=int, default=0,
+                    help="joiner entry-scale ramp ticks")
+    ap.add_argument("--chaos", default=None,
+                    help="fault plan (e.g. 'kill_coordinator:step=1') — "
+                         "drills the abort/rollback path instead")
+    ap.add_argument("--flight-dir", default=None,
+                    help="flight bundle directory for the postmortem")
+    args = ap.parse_args()
+
+    if args.virtual_cpu < args.target:
+        raise SystemExit(
+            f"--virtual-cpu {args.virtual_cpu} cannot host "
+            f"--target {args.target}")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count="
+            f"{args.virtual_cpu}").strip()
+    if args.flight_dir:
+        os.environ["BLUEFOG_FLIGHT_DIR"] = args.flight_dir
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import bluefog_tpu as bf
+    from bluefog_tpu import resilience as rz
+    from bluefog_tpu.utils import chaos as bfchaos
+    from bluefog_tpu.utils import flight as bfflight
+    from bluefog_tpu.utils import metrics as bfm
+
+    bf.init(devices=jax.devices()[:args.world])
+    ctx = bf.get_context()
+    rng = np.random.default_rng(7)
+    w = jax.device_put(
+        rng.standard_normal((args.world, 16)).astype(np.float32),
+        NamedSharding(ctx.mesh, P("rank")))
+    params = {"w": w}
+    for s in range(args.steps):
+        params = {"w": bf.neighbor_allreduce(params["w"])}
+    jax.block_until_ready(params["w"])
+    pre = np.asarray(params["w"])
+
+    doc = {"schema": SCHEMA, "ok": False, "world_before": args.world,
+           "world_after": None, "target": args.target,
+           "committed": False, "aborted": False, "chaos": args.chaos}
+    if args.chaos:
+        bfchaos.install(args.chaos)
+    try:
+        new_params, handle = rz.regrow_world(
+            args.target, params, warmup_steps=args.warmup_steps)
+    except rz.RegrowAborted as e:
+        doc["aborted"] = True
+        doc["abort_phase"] = e.phase
+        doc["abort_rank"] = e.rank
+        doc["world_after"] = bf.get_context().size
+        # the rollback contract: the OLD world must still step
+        out = bf.neighbor_allreduce(params["w"])
+        jax.block_until_ready(out)
+        doc["old_world_steps_after_abort"] = True
+        doc["ok"] = bool(doc["world_after"] == args.world
+                         and not rz.regrow_pending())
+    else:
+        # survivors' rows crossed the mesh boundary losslessly
+        carried = np.asarray(new_params["w"])[:min(args.world, args.target)]
+        lossless = bool(np.array_equal(carried, pre[:len(carried)]))
+        out = bf.neighbor_allreduce(new_params["w"])
+        jax.block_until_ready(out)
+        doc["committed"] = handle.commit()
+        doc["world_after"] = bf.get_context().size
+        doc["coordinator"] = handle.coordinator
+        doc["joiners"] = list(handle.joiners)
+        doc["duration_s"] = round(handle.duration_s, 6)
+        doc["carry_lossless"] = lossless
+        doc["retraces_after_warmup"] = int(
+            bfm.counter("bluefog_retrace_after_warmup_total").total())
+        doc["ok"] = bool(doc["world_after"] == args.target
+                         and doc["committed"] and lossless
+                         and not rz.regrow_pending())
+    finally:
+        if args.chaos:
+            bfchaos.uninstall()
+    if args.flight_dir:
+        doc["flight_bundle"] = bfflight.dump(reason="regrow_drill")
+    print(json.dumps(doc))
+    sys.exit(0 if doc["ok"] else 1)
+
+
+if __name__ == "__main__":
+    main()

@@ -34,13 +34,26 @@ and, when the fast paths are armed (schema 2 rows):
   the raw layout (the float64 logit-drift bound is pinned in
   ``tests/test_serve_fast.py``).
 
-Emits a ``bluefog-serve-bench-2`` JSON artifact (last stdout line, and
+With ``--traffic-trace`` (schema 3) the drain is followed by a bursty
+traffic phase driven by a synthetic arrival trace (``diurnal`` — one
+day-cycle sinusoid — or ``flash-crowd`` — a low base rate with a sudden
+spike): the highest serve replica starts *parked* (out of rotation) and
+an :class:`~bluefog_tpu.serve.scheduler.AutoScaler` watching queue depth
++ EWMA p99 must grow it back into the spike (writing the bfrun scale
+file on the way) and retire it after the cooldown.  The artifact's
+``trace`` row records the grow step, SLO recovery time (asserted under a
+bound), scale events, and the requeued-vs-failed split — the gate
+demands **zero failed requests** across the scale events.
+
+Emits a ``bluefog-serve-bench-3`` JSON artifact (last stdout line, and
 ``--out``).
 
 Run:    python tools/serve_bench.py --train-dp 2 --serve-dp 2 --pp 2 --out ...
 Smoke:  python tools/serve_bench.py --virtual-cpu --smoke
 Fast:   python tools/serve_bench.py --virtual-cpu --smoke \
             --spec-decode 3@1 --prefix-pages 2x8 --kv-dtype int8
+Trace:  python tools/serve_bench.py --virtual-cpu --smoke \
+            --traffic-trace flash-crowd
 """
 import argparse
 import dataclasses
@@ -53,7 +66,109 @@ import time
 REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
 sys.path.insert(0, REPO)
 
-SCHEMA = "bluefog-serve-bench-2"
+SCHEMA = "bluefog-serve-bench-3"
+
+
+def _trace_arrivals(shape, steps, slots, rng):
+    """Per-step request arrival counts for a synthetic traffic shape.
+
+    ``diurnal``: one full day cycle, midnight troughs and a midday peak
+    sized to breach the queue-depth watermark.  ``flash-crowd``: a low
+    base rate with a sudden spike of ``3*slots`` requests one third in.
+    """
+    import math
+    if shape == "diurnal":
+        # peak sized to oversubscribe ONE replica (forcing the grow) while
+        # staying drainable by two before the recovery bound
+        hi = max(4, (3 * slots) // 4)
+        return [int(round(hi * 0.5 * (1.0 - math.cos(2.0 * math.pi
+                                                     * t / steps))))
+                for t in range(steps)]
+    if shape == "flash-crowd":
+        arrivals = [1] * steps
+        arrivals[steps // 3] += 3 * slots
+        return arrivals
+    raise ValueError(f"unknown traffic shape {shape!r}")
+
+
+def _run_traffic_trace(engine, shape, *, steps, vocab, max_new, rng,
+                       slo_p99_ms=None):
+    """The schema-3 bursty phase: parked reserve replica, arrival-trace
+    traffic, and an AutoScaler that must grow into the spike.  Returns
+    the artifact's ``trace`` row."""
+    import tempfile
+    from bluefog_tpu.run.launcher import _read_scale
+    from bluefog_tpu.serve import Scheduler
+    from bluefog_tpu.serve.scheduler import AutoScaler
+
+    sched = Scheduler(engine)
+    parked = [sched.replicas - 1] if sched.replicas >= 2 else []
+    for r in parked:
+        sched.fail_replica(r, reason="parked")   # no traffic yet: clean park
+    scale_file = os.path.join(tempfile.mkdtemp(prefix="bfscale_"),
+                              "bluefog_scale")
+    scaler = AutoScaler(
+        sched,
+        slo_p99_s=(slo_p99_ms / 1000.0) if slo_p99_ms else None,
+        queue_high=engine.scfg.slots,       # breach when one replica's
+        cooldown_steps=3,                   # worth of slots is waiting
+        scale_file=scale_file, min_replicas=1)
+    arrivals = _trace_arrivals(shape, steps, engine.scfg.slots, rng)
+    submitted = 0
+    grow_step = None
+    recovered_step = None
+    t = 0
+
+    def _tick():
+        nonlocal grow_step, recovered_step
+        sched.step()
+        ev = scaler.observe()
+        if ev and ev["action"] == "grow" and grow_step is None:
+            grow_step = t
+        if (grow_step is not None and recovered_step is None
+                and sched.pending == 0):
+            recovered_step = t
+
+    for t in range(steps):
+        for _ in range(arrivals[t]):
+            n = int(rng.integers(2, engine.scfg.prefill_buckets[-1] + 1))
+            sched.submit(rng.integers(0, vocab, n).tolist(),
+                         max_new_tokens=max_new)
+            submitted += 1
+        _tick()
+    while not sched.done:
+        t += 1
+        if t > steps + 100_000:
+            raise RuntimeError("traffic trace failed to drain")
+        _tick()
+
+    bound = 2 * steps
+    recovery = (recovered_step - grow_step
+                if grow_step is not None and recovered_step is not None
+                else None)
+    row = {
+        "shape": shape,
+        "steps": steps,
+        "parked_replicas": parked,
+        "submitted": submitted,
+        "completed": len(sched.completed),
+        "failed": len(sched.failed),
+        "requeued": sched.requeued_total,
+        "grow_step": grow_step,
+        "recovery_steps": recovery,
+        "recovery_bound_steps": bound,
+        "slo_p99_s": scaler.slo_p99_s,
+        "ewma_p99_s": scaler.ewma_p99,
+        "scale_events": scaler.events,
+        "scale_file_target": _read_scale(scale_file),
+        "ok": bool(submitted == len(sched.completed)
+                   and not sched.failed
+                   and grow_step is not None
+                   and recovery is not None and recovery <= bound
+                   and _read_scale(scale_file) is not None),
+    }
+    sched.close()
+    return row
 
 
 def _load_tool(name):
@@ -103,6 +218,15 @@ def main():
     ap.add_argument("--prefix-pages", default=None,
                     help="shared prefix pages: '<pages>' or "
                          "'<pages>x<page_tokens>' (default off)")
+    ap.add_argument("--traffic-trace", default=None,
+                    choices=("diurnal", "flash-crowd"),
+                    help="bursty traffic phase with a parked reserve "
+                         "replica + SLO-driven autoscaling (schema 3 row)")
+    ap.add_argument("--trace-steps", type=int, default=None,
+                    help="scheduler steps in the traffic trace (default 24)")
+    ap.add_argument("--slo-p99-ms", type=float, default=None,
+                    help="autoscaler p99 SLO (default BLUEFOG_SLO_P99_MS "
+                         "or 250)")
     ap.add_argument("--train-steps", type=int, default=None,
                     help="train steps interleaved with serving (default 6)")
     ap.add_argument("--refresh-every", type=int, default=None,
@@ -308,6 +432,14 @@ def main():
                  - tokens0)
     tok_per_sec = tokens / dt if dt > 0 else None
 
+    # -- bursty traffic + autoscaling phase (schema 3) ----------------------
+    trace_doc = None
+    if args.traffic_trace:
+        trace_doc = _run_traffic_trace(
+            engine, args.traffic_trace, steps=args.trace_steps or 24,
+            vocab=vocab, max_new=max_new, rng=rng,
+            slo_p99_ms=args.slo_p99_ms)
+
     lat = bfm.get_metric("bluefog_serve_token_latency_seconds")
     ttfts = sorted(r.ttft for r in sched.completed if r.ttft is not None)
 
@@ -421,6 +553,7 @@ def main():
         "spec": spec_doc,
         "prefix": prefix_doc,
         "kv": kv_doc,
+        "trace": trace_doc,
         "invariants": {
             "donation_intact": bool(cache_probe.is_deleted()),
             "retraces_after_warmup": retraces,
@@ -439,6 +572,7 @@ def main():
                      and doc["invariants"]["donation_intact"]
                      and retraces == 0
                      and fast_ok
+                     and (trace_doc is None or trace_doc["ok"])
                      and (train_steps == 0 or pulls >= 1))
     sched.close()
     _emit(doc, args.out)

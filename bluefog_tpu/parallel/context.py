@@ -326,6 +326,16 @@ def reinit(world_size: int, *,
                 f"{len(old) + len(spare)} device(s) "
                 f"({len(old)} in use + {len(spare)} spare)")
         devs_list = old + spare[:need]
+    # validate EVERY precondition before anything is torn down: raising
+    # past this point would leave a half-torn world (distributed client
+    # re-formed, new mesh installed, carving gone)
+    old_compose = _active_compose
+    if old_compose is not None and world_size % old_compose.slice_size:
+        raise ValueError(
+            f"world size {world_size} is not a multiple of the active "
+            f"carving's slice size {old_compose.slice_size} "
+            f"(pp={old_compose.pp} tp={old_compose.tp} "
+            f"sp={old_compose.sp})")
     _rebootstrap_distributed(world_size)
 
     from ..utils import metrics as _metrics
@@ -349,22 +359,15 @@ def reinit(world_size: int, *,
         round_parallel=ctx.round_parallel, dcn_wire=ctx.dcn_wire,
         async_staleness=ctx.async_staleness)
 
-    old_compose = _active_compose
     with _lock:
         _context = new_ctx
         _active_compose = None
     if old_compose is not None:
-        slice_size = old_compose.slice_size
-        if world_size % slice_size:
-            raise ValueError(
-                f"world size {world_size} is not a multiple of the active "
-                f"carving's slice size {slice_size} "
-                f"(pp={old_compose.pp} tp={old_compose.tp} "
-                f"sp={old_compose.sp})")
         from . import compose as _compose
         _compose.compose_parallelism(
-            world_size // slice_size, old_compose.pp, old_compose.tp,
-            old_compose.sp, devices=devs_list, wire=old_compose.wire)
+            world_size // old_compose.slice_size, old_compose.pp,
+            old_compose.tp, old_compose.sp, devices=devs_list,
+            wire=old_compose.wire)
 
     # the old world's membership registry (and its pristine baseline) is
     # meaningless against the new mesh — re-baseline from scratch
@@ -401,6 +404,12 @@ def _install(ctx: BlueFogTpuContext, compose=None) -> None:
     with _lock:
         _context = ctx
         _active_compose = compose
+    # in a real multi-process job _rebootstrap_distributed mutated this
+    # to the aborted target; a later launch/reinit must see the world
+    # actually installed (the single-process sim never mutates it)
+    if (os.environ.get("BLUEFOG_COORDINATOR")
+            and int(os.environ.get("BLUEFOG_NUM_PROCESSES", "1")) > 1):
+        os.environ["BLUEFOG_NUM_PROCESSES"] = str(ctx.size)
     from ..utils import metrics as _metrics
     _metrics.mark_steady_state(False)
 

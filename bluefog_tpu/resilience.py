@@ -626,8 +626,8 @@ def reset() -> None:
         _warmup.clear()
         _pristine = None
         _warned_send_scales = False
-    _regrow_pending = None
-    _regrow_status.clear()
+        _regrow_pending = None
+        _regrow_status.clear()
     if forgotten:
         _diag.clear_peer_failures(forgotten)
     _metrics.gauge("bluefog_dead_ranks", _DEAD_HELP).set(0)

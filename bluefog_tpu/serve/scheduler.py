@@ -102,6 +102,7 @@ class Scheduler:
         self._active: List[Dict[int, Request]] = [
             {} for _ in range(self.replicas)]
         self._dead: set = set()
+        self._parked: set = set()        # autoscale-parked subset of _dead
         self._next_id = 0
         self._last_ids: List[List[int]] = [[] for _ in range(self.replicas)]
         self.completed: List[Request] = []
@@ -141,8 +142,8 @@ class Scheduler:
 
     # ------------------------------------------------------------------
 
-    def fail_replica(self, replica: int,
-                     reason: str = "failed") -> List[Request]:
+    def fail_replica(self, replica: int, reason: str = "failed",
+                     park: bool = False) -> List[Request]:
         """Take a replica out of rotation (chaos kill / health eviction /
         autoscale retire).
 
@@ -151,12 +152,25 @@ class Scheduler:
         its prompt and requeued at the HEAD of the admission queue (it
         already waited its turn once) with ``requeued`` stamped into the
         request and ``bluefog_requests_total{status="requeued"}``
-        counted.  They re-prefill on a survivor at the next admit;
-        queued requests behind them are untouched.
+        counted.  That label is per-EVENT, not per-request: a request
+        evicted twice is counted twice, so ``requeued`` does not sum with
+        the terminal ``done``/``failed`` statuses.  Re-delivery caveat
+        for streaming consumers: ``generated`` is cleared because the KV
+        behind it died, so tokens already streamed to a client are
+        produced again when the request re-runs — dedupe on request id
+        downstream if exactly-once token delivery matters.
+
+        ``park=True`` marks this an autoscale park/retire: the slice
+        stays alive (its engine state — KV pages, sealed prefixes — is
+        intact, merely unscheduled), so :meth:`restore_replica` may
+        re-admit traffic to it as-is.  Chaos kills and health evictions
+        must leave ``park=False``: their backing slice is gone.
         """
         if replica in self._dead:
             return []
         self._dead.add(replica)
+        if park:
+            self._parked.add(replica)
         lost = list(self._active[replica].values())
         for req in lost:
             self._alloc[replica].free(req.slot)
@@ -171,7 +185,9 @@ class Scheduler:
             self.requeued_total += 1
             _metrics.counter(
                 "bluefog_requests_total",
-                "serve requests by terminal status").inc(status="requeued")
+                "serve request events by status (done/failed are terminal "
+                "and count once; requeued counts once per eviction)"
+            ).inc(status="requeued")
         self._active[replica].clear()
         # head requeue, original arrival order preserved among the evicted
         self._queue.extendleft(reversed(lost))
@@ -182,13 +198,28 @@ class Scheduler:
         return lost
 
     def restore_replica(self, replica: int) -> bool:
-        """Bring a previously-failed replica back into rotation (the
-        autoscale grow path: a parked reserve replica re-admits traffic).
-        Returns True if the replica was dead."""
+        """Bring a previously-failed replica back into rotation.
+        Returns True if the replica was dead.
+
+        A replica parked via ``fail_replica(park=True)`` re-admits
+        traffic as-is — its slice never died, so its sealed prefix pages
+        are still backed by live KV.  A replica that actually failed
+        (chaos kill / health eviction) lost that KV with the slice, so
+        its prefix directory is rebuilt empty here: re-attaching the old
+        sealed rows would serve garbage KV to every later hit.
+        """
         if replica not in self._dead:
             return False
         self._dead.discard(replica)
-        _flight.record("serve", name="replica_restored", replica=replica)
+        parked = replica in self._parked
+        self._parked.discard(replica)
+        if not parked and self._prefix[replica] is not None:
+            scfg = self.engine.scfg
+            self._prefix[replica] = PrefixCache(
+                scfg.prefix_pages, scfg.prefix_page_tokens,
+                first_row=scfg.slots, replica=replica)
+        _flight.record("serve", name="replica_restored", replica=replica,
+                       parked=parked)
         return True
 
     # ------------------------------------------------------------------
@@ -367,7 +398,9 @@ class Scheduler:
         self.completed.append(req)
         _metrics.counter(
             "bluefog_requests_total",
-            "serve requests by terminal status").inc(status="done")
+            "serve request events by status (done/failed are terminal "
+            "and count once; requeued counts once per eviction)"
+        ).inc(status="done")
         return True
 
     # ------------------------------------------------------------------
@@ -377,6 +410,7 @@ class Scheduler:
         block = {
             "replicas": self.replicas,
             "dead_replicas": sorted(self._dead),
+            "parked_replicas": sorted(self._parked),
             "pending": self.pending,
             "in_flight": {str(r): sorted(req.id
                                          for req in self._active[r].values())
@@ -404,11 +438,19 @@ class AutoScaler:
     admission-queue depth and an EWMA of the p99 of the existing
     ``bluefog_serve_token_latency_seconds`` histogram — and closes the
     elastic loop: a sustained breach *grows* the serving fleet (restores
-    the lowest parked/dead replica AND writes ``target`` into the bfrun
-    scale file so the supervisor regrows the world under it), a quiet
-    queue well under the SLO *retires* the highest live replica after a
-    cooldown.  Retirement uses the requeue path, so shrinking never fails
-    a request.
+    the lowest PARKED replica — one retired by this scaler, whose slice
+    is intact; a chaos-killed replica's KV died with it and is never
+    re-admitted here — AND writes the new target into the bfrun scale
+    file so the supervisor regrows the world under it), a quiet queue
+    well under the SLO *retires* the highest live replica after a
+    cooldown.  Retirement uses the requeue path, so shrinking never
+    fails a request.
+
+    The scale file speaks the supervisor's unit: RANKS (world size), not
+    replicas.  Each serve replica is a PP×TP×SP slice of
+    ``ranks_per_replica`` ranks (default: the engine mesh's
+    ``slice_size``), so every action writes
+    ``live_replicas * ranks_per_replica``.
 
     Knobs (env defaults): ``BLUEFOG_AUTOSCALE`` gates
     :meth:`enabled_from_env`; ``BLUEFOG_SLO_P99_MS`` sets the p99 target
@@ -422,7 +464,8 @@ class AutoScaler:
                  cooldown_steps: int = 50,
                  scale_file: Optional[str] = None,
                  min_replicas: int = 1,
-                 alpha: float = 0.2):
+                 alpha: float = 0.2,
+                 ranks_per_replica: Optional[int] = None):
         from ..utils.config import env_float
         if slo_p99_s is None:
             slo_p99_s = env_float("BLUEFOG_SLO_P99_MS", 250.0) / 1000.0
@@ -438,6 +481,14 @@ class AutoScaler:
         self.cooldown_steps = int(cooldown_steps)
         self.scale_file = scale_file
         self.min_replicas = max(1, int(min_replicas))
+        if ranks_per_replica is None:
+            # replicas -> ranks: each serve replica is one PP*TP*SP slice
+            ranks_per_replica = getattr(
+                getattr(sched.engine, "m", None), "slice_size", 1)
+        if int(ranks_per_replica) < 1:
+            raise ValueError(
+                f"ranks_per_replica must be >= 1, got {ranks_per_replica}")
+        self.ranks_per_replica = int(ranks_per_replica)
         self.alpha = float(alpha)
         self.ewma_p99: Optional[float] = None
         self.events: List[dict] = []
@@ -459,18 +510,22 @@ class AutoScaler:
 
     def _record(self, action: str, replica: int) -> None:
         live = len(self.sched.live_replicas())
+        target_world = live * self.ranks_per_replica
         ev = {"step": self._step, "action": action, "replica": replica,
               "live_replicas": live,
+              "target_world": target_world,
               "pending": self.sched.pending,
               "ewma_p99_s": self.ewma_p99}
         self.events.append(ev)
         self._last_action_step = self._step
-        self._write_scale(live)
+        # the supervisor's unit is ranks, not replicas
+        self._write_scale(target_world)
         _metrics.counter(
             "bluefog_autoscale_events_total",
             "autoscale actions by direction").inc(action=action)
         _flight.record("autoscale", name=action, replica=replica,
-                       live_replicas=live, pending=self.sched.pending,
+                       live_replicas=live, target_world=target_world,
+                       pending=self.sched.pending,
                        ewma_p99_s=self.ewma_p99)
 
     # ------------------------------------------------------------------
@@ -493,8 +548,10 @@ class AutoScaler:
         breach = (sched.pending > self.queue_high
                   or (self.ewma_p99 is not None
                       and self.ewma_p99 > self.slo_p99_s))
-        if breach and sched._dead:
-            replica = min(sched._dead)
+        if breach and sched._parked:
+            # only autoscale-parked replicas re-admit traffic: a
+            # chaos-killed/health-evicted one lost its KV with the slice
+            replica = min(sched._parked)
             sched.restore_replica(replica)
             self._record("grow", replica)
             return self.events[-1]
@@ -504,7 +561,7 @@ class AutoScaler:
                      or self.ewma_p99 < 0.5 * self.slo_p99_s))
         if calm and len(live) > self.min_replicas:
             replica = max(live)
-            sched.fail_replica(replica, reason="retired")
+            sched.fail_replica(replica, reason="retired", park=True)
             self._record("retire", replica)
             return self.events[-1]
         return None

@@ -103,6 +103,24 @@ def test_reinit_rebuilds_compose_carving(cpu_devices):
         bf.shutdown()
 
 
+def test_reinit_indivisible_world_rejected_before_teardown(cpu_devices):
+    """A target that doesn't divide the active carving's slice size must
+    raise BEFORE anything is torn down: same context, same carving — not
+    a half-torn world with the compose dropped."""
+    from bluefog_tpu.parallel import compose
+    bf.init(devices=cpu_devices[:4])
+    m = compose.compose_parallelism(2, 2, 1, 1,
+                                    devices=list(cpu_devices[:4]))
+    old = bf.get_context()
+    try:
+        with pytest.raises(ValueError, match="not a multiple"):
+            bfctx.reinit(5)                 # 5 % slice_size(=2) != 0
+        assert bf.get_context() is old
+        assert bfctx.get_compose() is m
+    finally:
+        bf.shutdown()
+
+
 # ---------------------------------------------------------------------------
 # regrow_world: the protocol
 # ---------------------------------------------------------------------------
@@ -312,16 +330,21 @@ def test_float64_regrow_matches_fresh_world_oracle():
 class _StubSched:
     """The Scheduler surface AutoScaler drives, without an engine."""
 
-    def __init__(self, replicas=2, slots=4):
+    def __init__(self, replicas=2, slots=4, slice_size=1):
         class _Scfg:
+            pass
+        class _M:
             pass
         class _Eng:
             pass
         self.engine = _Eng()
         self.engine.scfg = _Scfg()
         self.engine.scfg.slots = slots
+        self.engine.m = _M()
+        self.engine.m.slice_size = slice_size
         self.replicas = replicas
         self._dead = set()
+        self._parked = set()
         self.pending = 0
         self.restored = []
         self.retired = []
@@ -331,11 +354,14 @@ class _StubSched:
 
     def restore_replica(self, r):
         self._dead.discard(r)
+        self._parked.discard(r)
         self.restored.append(r)
         return True
 
-    def fail_replica(self, r, reason="failed"):
+    def fail_replica(self, r, reason="failed", park=False):
         self._dead.add(r)
+        if park:
+            self._parked.add(r)
         self.retired.append((r, reason))
         return []
 
@@ -344,7 +370,7 @@ def test_autoscaler_grows_on_queue_breach(tmp_path):
     from bluefog_tpu.run.launcher import _read_scale
     from bluefog_tpu.serve.scheduler import AutoScaler
     sched = _StubSched()
-    sched._dead.add(1)                      # the parked reserve replica
+    sched.fail_replica(1, reason="parked", park=True)   # parked reserve
     scale_file = str(tmp_path / "bluefog_scale")
     sc = AutoScaler(sched, slo_p99_s=0.25, queue_high=4, cooldown_steps=2,
                     scale_file=scale_file)
@@ -353,10 +379,77 @@ def test_autoscaler_grows_on_queue_breach(tmp_path):
     sched.pending = 9                       # breach
     ev = sc.observe()
     assert ev and ev["action"] == "grow" and ev["replica"] == 1
+    assert ev["target_world"] == 2
     assert sched.restored == [1]
     assert _read_scale(scale_file) == 2     # the supervisor's join queue
     assert int(bfm.counter(
         "bluefog_autoscale_events_total").value(action="grow")) == 1
+
+
+def test_autoscaler_scale_file_speaks_ranks(tmp_path):
+    """The scale target is a WORLD SIZE: live replicas x slice size.
+    With pp=2-style slices (slice_size=2) a grow to 2 live replicas must
+    write 4 — writing the replica count would make the supervisor SIGTERM
+    half the world mid-breach."""
+    from bluefog_tpu.run.launcher import _read_scale
+    from bluefog_tpu.serve.scheduler import AutoScaler
+    sched = _StubSched(slice_size=2)
+    sched.fail_replica(1, reason="parked", park=True)
+    scale_file = str(tmp_path / "bluefog_scale")
+    sc = AutoScaler(sched, slo_p99_s=0.25, queue_high=4, cooldown_steps=1,
+                    scale_file=scale_file)
+    assert sc.ranks_per_replica == 2        # derived from engine.m
+    sched.pending = 9                       # breach
+    ev = sc.observe()
+    assert ev and ev["action"] == "grow"
+    assert ev["live_replicas"] == 2 and ev["target_world"] == 4
+    assert _read_scale(scale_file) == 4
+
+
+def test_autoscaler_never_readmits_killed_replica(tmp_path):
+    """A dead-but-not-parked replica (chaos kill / health eviction) lost
+    its KV with the slice: a breach must NOT restore it."""
+    from bluefog_tpu.serve.scheduler import AutoScaler
+    sched = _StubSched()
+    sched.fail_replica(1, reason="failed")  # real failure, not a park
+    sc = AutoScaler(sched, slo_p99_s=0.25, queue_high=4, cooldown_steps=1,
+                    scale_file=str(tmp_path / "s"))
+    sched.pending = 9                       # breach
+    assert sc.observe() is None
+    assert sched.restored == [] and 1 in sched._dead
+
+
+def test_restore_replica_prefix_directory_semantics():
+    """Restoring a PARKED replica keeps its sealed prefix directory (the
+    slice never died); restoring after a real failure rebuilds it empty —
+    the old sealed rows' KV perished with the slice."""
+    from bluefog_tpu.serve.scheduler import Scheduler
+
+    class _Scfg:
+        slots = 4
+        prefix_pages = 2
+        prefix_page_tokens = 4
+    class _M:
+        dp = 2
+    class _Eng:
+        m = _M()
+        scfg = _Scfg()
+
+    sched = Scheduler(_Eng())
+    try:
+        pc = sched._prefix[1]
+        assert pc is not None
+        sched.fail_replica(1, reason="parked", park=True)
+        assert sched._parked == {1}
+        assert sched.restore_replica(1)
+        assert sched._prefix[1] is pc       # intact slice: pages survive
+        assert sched._parked == set()
+        sched.fail_replica(1, reason="failed")
+        assert sched._parked == set()
+        assert sched.restore_replica(1)
+        assert sched._prefix[1] is not pc   # KV died: directory rebuilt
+    finally:
+        sched.close()
 
 
 def test_autoscaler_retires_after_cooldown(tmp_path):

@@ -104,7 +104,8 @@ def _run_traffic_trace(engine, shape, *, steps, vocab, max_new, rng,
     sched = Scheduler(engine)
     parked = [sched.replicas - 1] if sched.replicas >= 2 else []
     for r in parked:
-        sched.fail_replica(r, reason="parked")   # no traffic yet: clean park
+        # no traffic yet: clean park (slice intact, eligible for re-admit)
+        sched.fail_replica(r, reason="parked", park=True)
     scale_file = os.path.join(tempfile.mkdtemp(prefix="bfscale_"),
                               "bluefog_scale")
     scaler = AutoScaler(
@@ -146,6 +147,11 @@ def _run_traffic_trace(engine, shape, *, steps, vocab, max_new, rng,
     recovery = (recovered_step - grow_step
                 if grow_step is not None and recovered_step is not None
                 else None)
+    # the scale file speaks RANKS: live replicas x slice size.  Gate the
+    # actual written value, not just its presence — a replica-count write
+    # would make the supervisor SIGTERM ranks during the breach.
+    scale_target = _read_scale(scale_file)
+    expected_world = len(sched.live_replicas()) * engine.m.slice_size
     row = {
         "shape": shape,
         "steps": steps,
@@ -160,12 +166,17 @@ def _run_traffic_trace(engine, shape, *, steps, vocab, max_new, rng,
         "slo_p99_s": scaler.slo_p99_s,
         "ewma_p99_s": scaler.ewma_p99,
         "scale_events": scaler.events,
-        "scale_file_target": _read_scale(scale_file),
+        "scale_file_target": scale_target,
+        "ranks_per_replica": engine.m.slice_size,
+        "expected_world": expected_world,
         "ok": bool(submitted == len(sched.completed)
                    and not sched.failed
                    and grow_step is not None
                    and recovery is not None and recovery <= bound
-                   and _read_scale(scale_file) is not None),
+                   and scale_target == expected_world
+                   and (not scaler.events
+                        or scale_target ==
+                        scaler.events[-1]["target_world"])),
     }
     sched.close()
     return row

@@ -7,7 +7,8 @@ PY ?= python
 .PHONY: test test-fast test_basic test_ops test_win_ops test_optimizer \
 	test_hier test_native test_examples verify native clean hw-watch \
 	obs-smoke chaos-smoke overlap-smoke postmortem-smoke pod-smoke \
-	autotune-smoke elastic-smoke lm-smoke serve-smoke serve-fast-smoke \
+	autotune-smoke elastic-smoke lm-smoke moe-smoke serve-smoke \
+	serve-fast-smoke \
 	async-smoke regrow-smoke
 
 test:
@@ -157,7 +158,7 @@ lm-smoke:
 		--out /tmp/lm_bench_smoke.json
 	$(PY) -c "import json; \
 		d = json.load(open('/tmp/lm_bench_smoke.json')); \
-		assert d['schema'] == 'bluefog-lm-bench-1' and d['ok'], d; \
+		assert d['schema'] == 'bluefog-lm-bench-2' and d['ok'], d; \
 		i = d['invariants']; \
 		assert i['donation_intact'] and \
 		i['retraces_after_warmup'] == 0, i; \
@@ -166,6 +167,27 @@ lm-smoke:
 		w['dcn_dtypes'] == ['bf16'] and w['ici_dtypes'] == ['f32'], w; \
 		assert d['tokens_per_sec'] > 0 and len(d['wire_sweep']) == 3, d; \
 		print('lm-smoke OK')"
+
+# routed-MoE smoke: the 5-axis MoE proof battery (eager contracts, probe,
+# 32-chip byte attribution, float64 oracle, carving tuner) plus the
+# lm_bench --moe grader AOT-only with the byte-attribution assert —
+# expert all_to_alls intra-slice, gossip the only DCN traffic
+moe-smoke:
+	$(PY) -m pytest tests/test_moe.py tests/test_expert.py -q
+	$(PY) tools/lm_bench.py --virtual-cpu --smoke --aot-only --no-sweep \
+		--moe --dp 2 --pp 2 --tp 1 --sp 1 --ep 2 --experts 4 \
+		--wire bf16 --out /tmp/lm_bench_moe_smoke.json
+	$(PY) -c "import json; \
+		d = json.load(open('/tmp/lm_bench_moe_smoke.json')); \
+		assert d['schema'] == 'bluefog-lm-bench-2' and d['ok'], d; \
+		m = d['moe']; \
+		assert m['num_experts'] == 4 and m['ep'] == 2, m; \
+		assert m['capacity'] >= 1 and m['n_active_params'] > 0, m; \
+		w = d['wire_bytes']; \
+		assert 'all_to_all' in w['ici'], w; \
+		assert set(w['dcn']) == {'collective_permute'} and \
+		w['dcn_dtypes'] == ['bf16'], w; \
+		print('moe-smoke OK')"
 
 # serving smoke: the serve battery (decode oracle, KV slot reuse, bucket
 # zero-retrace, the 8-rank train+serve e2e, the chaos drill) plus the

@@ -7,17 +7,26 @@ artifacts; optional live micro-trials), and returns a deterministic
 JSON-serializable :class:`Plan` that reconstructs the configured
 optimizer and context knobs anywhere — see :func:`autotune`.
 
+:func:`tune_carving` extends the search to the mesh itself: it
+enumerates ``(dp, pp, tp, sp, ep)`` carvings — the expert axis included,
+with the MoE contract rules (``ep>1`` requires a composed MoE carving
+with a divisible expert count) surfaced as audited rejections — and
+ranks them by AOT-counted cross-slice (DCN) bytes per step.
+
 CLI: ``python -m bluefog_tpu.autotune --virtual-cpu --smoke``.
 """
 from .candidates import (
-    Candidate, default_topologies, enumerate_candidates, schedule_for,
+    Candidate, CarvingCandidate, carving_violation, default_topologies,
+    enumerate_candidates, enumerate_carvings, schedule_for,
     two_level_split,
 )
 from .plan import PLAN_SCHEMA, Plan, load_plan, plan_id_of
-from .tuner import autotune
+from .tuner import CARVING_PLAN_SCHEMA, autotune, tune_carving
 
 __all__ = [
     "autotune", "Plan", "load_plan", "plan_id_of", "PLAN_SCHEMA",
     "Candidate", "enumerate_candidates", "default_topologies",
     "schedule_for", "two_level_split",
+    "CarvingCandidate", "carving_violation", "enumerate_carvings",
+    "tune_carving", "CARVING_PLAN_SCHEMA",
 ]

@@ -56,6 +56,112 @@ class Candidate(NamedTuple):
         }
 
 
+class CarvingCandidate(NamedTuple):
+    """One ``(dp, pp, tp, sp, ep)`` mesh carving the carving tuner can
+    score, reject, or pick (``tune_carving``).  The expert axis rides the
+    same contract :func:`~bluefog_tpu.parallel.compose.compose_parallelism`
+    enforces eagerly: ``ep > 1`` requires a composed carving with the total
+    expert count declared and divisible."""
+    dp: int
+    pp: int
+    tp: int
+    sp: int
+    ep: int
+
+    @property
+    def n_chips(self) -> int:
+        return self.dp * self.pp * self.tp * self.sp * self.ep
+
+    @property
+    def slice_size(self) -> int:
+        """Devices per DP replica — the intra-slice (ICI) budget."""
+        return self.pp * self.tp * self.sp * self.ep
+
+    @property
+    def key(self) -> str:
+        return (f"carve|dp={self.dp}|pp={self.pp}|tp={self.tp}"
+                f"|sp={self.sp}|ep={self.ep}")
+
+    def config(self) -> dict:
+        return {"dp": self.dp, "pp": self.pp, "tp": self.tp,
+                "sp": self.sp, "ep": self.ep}
+
+
+def carving_violation(carve: CarvingCandidate, n_chips: int,
+                      num_experts: Optional[int],
+                      require_gossip: bool = True) -> Optional[str]:
+    """The carving contract as audit-ready reason strings (None = legal).
+
+    Mirrors ``compose_parallelism``'s eager validation so a rejected
+    carving never reaches a compile, plus the tuner-level rule that the
+    gossip-DP axis must exist — a dp=1 carving has nothing decentralized
+    to tune."""
+    if carve.n_chips != n_chips:
+        return (f"carving_size_mismatch: dp*pp*tp*sp*ep = {carve.n_chips} "
+                f"!= device count ({n_chips})")
+    if require_gossip and carve.dp < 2:
+        return ("carving_no_gossip_axis: dp=1 leaves no gossip-DP "
+                "replicas; the decentralized contract (and any wire "
+                "codec) needs dp >= 2")
+    if carve.ep > 1:
+        if num_experts is None:
+            return ("moe_carving_requires_num_experts: ep>1 carves an "
+                    "expert axis, which only exists on a composed MoE "
+                    "carving with the total expert count declared")
+        if num_experts % carve.ep:
+            return (f"moe_carving_experts_not_divisible: num_experts "
+                    f"({num_experts}) % ep ({carve.ep}) != 0")
+    return None
+
+
+def _factorizations(n: int, k: int):
+    """All ordered k-tuples of positive ints with product n."""
+    if k == 1:
+        yield (n,)
+        return
+    for d in range(1, n + 1):
+        if n % d == 0:
+            for rest in _factorizations(n // d, k - 1):
+                yield (d,) + rest
+
+
+def enumerate_carvings(
+    n_chips: int,
+    *,
+    num_experts: Optional[int] = None,
+    require_gossip: bool = True,
+    max_pp: Optional[int] = None,
+    max_tp: Optional[int] = None,
+    max_sp: Optional[int] = None,
+    max_ep: Optional[int] = None,
+) -> Tuple[List[CarvingCandidate], List[dict]]:
+    """Enumerate ``(accepted, rejected)`` 5-axis carvings of n_chips.
+
+    Every ordered factorization ``dp*pp*tp*sp*ep == n_chips`` is
+    considered; contract violations land in ``rejected`` as
+    ``{"key", "config", "reason"}`` audit entries (same shape as
+    :func:`enumerate_candidates`'s).  The ``max_*`` bounds *prune* the
+    combinatorial space silently (they are search hints, not contracts) —
+    pass them to keep the lowered-candidate count sane on big meshes."""
+    if not isinstance(n_chips, (int,)) or n_chips < 1:
+        raise ValueError(f"n_chips={n_chips!r} must be a positive int")
+    accepted: List[CarvingCandidate] = []
+    rejected: List[dict] = []
+    bounds = (None, max_pp, max_tp, max_sp, max_ep)
+    for axes in _factorizations(n_chips, 5):
+        if any(b is not None and v > b for v, b in zip(axes, bounds)):
+            continue
+        cand = CarvingCandidate(*axes)
+        reason = carving_violation(cand, n_chips, num_experts,
+                                   require_gossip=require_gossip)
+        if reason is None:
+            accepted.append(cand)
+        else:
+            rejected.append({"key": cand.key, "config": cand.config(),
+                             "reason": reason})
+    return accepted, rejected
+
+
 def _topo_key(spec: Optional[dict]) -> str:
     if spec is None:
         return "none"

@@ -19,13 +19,15 @@ import numpy as np
 from ..parallel import context as _mesh
 from ..utils.hlo_bytes import wire_stats
 from .. import topology as topo_util
-from .candidates import Candidate, schedule_for
+from .candidates import Candidate, CarvingCandidate, schedule_for
 
 # Pseudo-cost constants (seconds).  These are NOT measurements — they are a
 # fixed, documented preference order: bytes dominate, each sequential gossip
 # round adds latency, each host dispatch adds overhead amortized by fused-k.
 # Tier-2/3 measured seconds override the pseudo-seconds wholesale.
 _BYTES_PER_SEC = 4.0e10          # ICI-class link, order-of-magnitude
+_DCN_BYTES_PER_SEC = 2.5e9       # cross-slice (DCN-class) link — the ~16x
+                                 # gap is why carvings are ranked DCN-first
 _ROUND_LATENCY_S = 2.0e-6        # per sequential permute round
 _DISPATCH_S = 50.0e-6            # per host->device call, / fused_k
 _EXPOSED_WHEN_DELAYED = 0.25     # fraction of comm left exposed when the
@@ -150,6 +152,69 @@ def objective_score(objective, step_time_s: float, gap: float,
     raise ValueError(
         f"unknown objective {objective!r}: 'step_time', "
         "'consensus_per_byte', or a weight dict over those")
+
+
+def carving_wire_bytes(carve: CarvingCandidate, cfg, *,
+                       wire: Optional[str] = None,
+                       remat: bool = False) -> dict:
+    """ICI-vs-DCN byte attribution for one 5-axis carving, from a real
+    AOT lowering of one full optimizer step (never a shape guess).
+
+    Composes the carving, builds the LM step — the routed-MoE one when
+    ``cfg`` is a :class:`~bluefog_tpu.moe.MoELMConfig`, the dense one
+    otherwise — lowers it, and splits the pre-optimization StableHLO's
+    collective bytes by slice with
+    :func:`~bluefog_tpu.utils.hlo_bytes.stablehlo_wire_stats`, exactly
+    the counter ``tools/lm_bench.py`` publishes.  The model contract
+    (``cfg.validate``) and the carving contract both raise here; the
+    carving tuner converts that into an audited rejection.  The process
+    context's active carving is restored on exit."""
+    import jax
+    import optax
+
+    from .. import optimizers as bfopt
+    from ..parallel import compose
+    from ..utils.hlo_bytes import stablehlo_wire_stats
+
+    carve_kw = {}
+    num_experts = getattr(cfg, "num_experts", None)
+    is_moe = num_experts is not None
+    if is_moe:
+        carve_kw = {"num_experts": num_experts,
+                    "capacity_factor": cfg.capacity_factor}
+    prior = _mesh.get_compose()
+    try:
+        m = compose.compose_parallelism(
+            carve.dp, carve.pp, carve.tp, carve.sp, carve.ep, wire=wire,
+            **carve_kw)
+        cfg.validate(m)
+        if is_moe:
+            from .. import moe as bfmoe
+            grad_fn = bfmoe.make_moe_grad_fn(cfg, m, remat=remat)
+            params = bfmoe.init_moe_params(cfg, m)
+            toks = bfmoe.make_moe_batch(cfg, m)
+        else:
+            grad_fn = compose.make_lm_grad_fn(cfg, m, remat=remat)
+            params = compose.init_lm_params(cfg, m)
+            toks = compose.make_lm_batch(cfg, m)
+        step, strategy = compose.make_train_step(
+            m, grad_fn, optax.sgd(0.05), delayed=True)
+        state = bfopt.init_distributed(strategy, params)
+        shlo = step.lower(params, state, toks).as_text()
+        stats = stablehlo_wire_stats(shlo, m.slice_size)
+        stats["slice_size"] = m.slice_size
+        return stats
+    finally:
+        _mesh.set_compose(prior)
+
+
+def predicted_carving_step_time_s(stats: dict) -> float:
+    """Analytic pseudo-seconds for a carving's per-step wire bill: DCN
+    bytes at DCN speed + ICI bytes at ICI speed.  Same caveat as the
+    strategy constants above — a documented preference order (DCN bytes
+    dominate), not a measurement."""
+    return (stats["dcn_bytes"] / _DCN_BYTES_PER_SEC
+            + stats["ici_bytes"] / _BYTES_PER_SEC)
 
 
 def num_schedule_rounds(cand: Candidate, n: int) -> int:

@@ -28,7 +28,8 @@ from ..parallel import context as _mesh
 from . import bank as _bank
 from . import cost_model as _cm
 from . import trials as _trials
-from .candidates import enumerate_candidates
+from .candidates import (CarvingCandidate, carving_violation,
+                         enumerate_candidates, enumerate_carvings)
 from .plan import Plan, make_plan_doc
 
 
@@ -197,3 +198,133 @@ def autotune(
     return Plan(make_plan_doc(
         config=cfg, objective=objective, n_chips=n,
         device_kind=device_kind, predicted=predicted, audit=audit))
+
+
+CARVING_PLAN_SCHEMA = "bluefog-carving-plan-1"
+
+
+def tune_carving(
+    cfg,
+    *,
+    wire: Optional[str] = "bf16",
+    objective: str = "dcn_bytes",
+    carvings: Optional[Sequence[Sequence[int]]] = None,
+    require_gossip: bool = True,
+    remat: bool = False,
+    max_pp: Optional[int] = None,
+    max_tp: Optional[int] = None,
+    max_sp: Optional[int] = None,
+    max_ep: Optional[int] = None,
+) -> dict:
+    """Learn the mesh carving — the ``(dp, pp, tp, sp, ep)`` axis split —
+    for one model config on the current device world.
+
+    The expert axis is part of the search: when ``cfg`` is a
+    :class:`~bluefog_tpu.moe.MoELMConfig` every legal ``ep`` shows up as a
+    candidate (``ep > 1`` on a dense config is an *audited rejection*, as
+    is ``num_experts % ep != 0`` — the same contract
+    ``compose_parallelism`` enforces eagerly).  Every surviving carving is
+    AOT-lowered for real (:func:`cost_model.carving_wire_bytes`) and
+    ranked by
+
+    * ``"dcn_bytes"`` (default): cross-slice bytes per chip per step,
+      ICI bytes as tie-break — the paper's objective, gossip being the
+      only DCN-crossing axis;
+    * ``"step_time"``: analytic pseudo-seconds over both byte classes
+      (:func:`cost_model.predicted_carving_step_time_s`).
+
+    Model-contract violations (``cfg.validate``) and compile failures
+    move candidates into the rejection audit rather than raising, so the
+    returned plan accounts for every enumerated carving.  Pass
+    ``carvings=[(dp, pp, tp, sp, ep), ...]`` to restrict the space (tests
+    and the smoke target do), or the ``max_*`` bounds to prune it.
+
+    Returns a deterministic JSON-ready dict (schema
+    ``bluefog-carving-plan-1``) whose ``best.config`` feeds
+    ``compose_parallelism`` directly.
+    """
+    ctx = _mesh.get_context()
+    n = ctx.size
+    num_experts = getattr(cfg, "num_experts", None)
+    if objective not in ("dcn_bytes", "step_time"):
+        raise ValueError(f"unknown objective {objective!r}: "
+                         "'dcn_bytes' or 'step_time'")
+
+    if carvings is not None:
+        accepted, rejected = [], []
+        for axes in carvings:
+            cand = CarvingCandidate(*(int(v) for v in axes))
+            reason = carving_violation(cand, n, num_experts,
+                                       require_gossip=require_gossip)
+            if reason is None:
+                accepted.append(cand)
+            else:
+                rejected.append({"key": cand.key, "config": cand.config(),
+                                 "reason": reason})
+    else:
+        accepted, rejected = enumerate_carvings(
+            n, num_experts=num_experts, require_gossip=require_gossip,
+            max_pp=max_pp, max_tp=max_tp, max_sp=max_sp, max_ep=max_ep)
+    considered = len(accepted) + len(rejected)
+
+    scored = []
+    for cand in accepted:
+        try:
+            stats = _cm.carving_wire_bytes(cand, cfg, wire=wire,
+                                           remat=remat)
+        except ValueError as e:               # model/carving contract
+            rejected.append({"key": cand.key, "config": cand.config(),
+                             "reason": f"contract: {e}"[:300]})
+            continue
+        except Exception as e:                # noqa: BLE001 — lowering
+            rejected.append({"key": cand.key, "config": cand.config(),
+                             "reason": f"compile failed: "
+                                       f"{type(e).__name__}: {e}"[:300]})
+            continue
+        step_s = _cm.predicted_carving_step_time_s(stats)
+        scored.append({"cand": cand,
+                       "dcn_bytes": int(stats["dcn_bytes"]),
+                       "ici_bytes": int(stats["ici_bytes"]),
+                       "dcn_dtypes": stats["dcn_dtypes"],
+                       "step_time_s": step_s})
+    if not scored:
+        raise RuntimeError(
+            "tune_carving: every carving was rejected or failed to "
+            f"compile ({len(rejected)} rejections; see the reasons)")
+
+    def sort_key(e):
+        if objective == "dcn_bytes":
+            return (e["dcn_bytes"], e["ici_bytes"], e["cand"].key)
+        return (e["step_time_s"], e["cand"].key)
+
+    scored.sort(key=sort_key)
+    best = scored[0]
+    return {
+        "schema": CARVING_PLAN_SCHEMA,
+        "objective": objective,
+        "n_chips": n,
+        "device_kind": ctx.devices[0].device_kind,
+        "wire": wire,
+        "model": {"n_params": cfg.n_params,
+                  "num_experts": num_experts,
+                  "capacity_factor": getattr(cfg, "capacity_factor", None),
+                  "top_k": getattr(cfg, "top_k", None)},
+        "best": {
+            "config": best["cand"].config(),
+            "dcn_bytes_per_step_per_chip": best["dcn_bytes"],
+            "ici_bytes_per_step_per_chip": best["ici_bytes"],
+            "dcn_dtypes": best["dcn_dtypes"],
+            "step_time_s": round(best["step_time_s"], 9),
+        },
+        "audit": {
+            "considered": considered,
+            "scored": [
+                {"key": e["cand"].key,
+                 "dcn_bytes": e["dcn_bytes"],
+                 "ici_bytes": e["ici_bytes"],
+                 "step_time_s": round(e["step_time_s"], 9)}
+                for e in scored],
+            "rejected": [{"key": r["key"], "reason": r["reason"]}
+                         for r in rejected],
+        },
+    }

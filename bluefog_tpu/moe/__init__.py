@@ -1,0 +1,24 @@
+"""Routed mixture-of-experts as a first-class composed workload.
+
+The package folds GShard/Switch-Transformer-style routed MoE into the
+5-axis composed world (``parallel.compose``): a top-k router with static
+capacity, expert-parallel dispatch over the ``"expert"`` mesh axis
+(``parallel.expert``), the auxiliary load-balance and router-z losses
+folded into training, and a reference routed-MoE LM on the PR 9 composed
+LM skeleton — pipelined over ``stage``, Megatron-TP inside every expert,
+Ulysses over ``sp``, gossip-DP over ``rank``, experts over ``expert``.
+
+Gossip remains the ONLY DCN-crossing axis: every expert all_to_all is
+intra-slice by construction (slice-major device sort keeps gossip-DP
+outermost), which tools/lm_bench.py ``--moe`` proves from the
+pre-optimization StableHLO.
+"""
+from .layers import moe_ffn_dense, moe_ffn_routed, router_topk
+from .model import (MoELMConfig, init_moe_params, make_moe_batch,
+                    make_moe_grad_fn, make_moe_probe)
+
+__all__ = [
+    "router_topk", "moe_ffn_routed", "moe_ffn_dense",
+    "MoELMConfig", "init_moe_params", "make_moe_batch",
+    "make_moe_grad_fn", "make_moe_probe",
+]

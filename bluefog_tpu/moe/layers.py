@@ -1,0 +1,162 @@
+"""Routed-MoE layer primitives: top-k router, expert FFN, dense oracle.
+
+These are the per-device building blocks the reference MoE LM
+(:mod:`.model`) runs inside the composed 5-axis shard_map.  They wrap the
+capacity-based dispatch machinery of :mod:`..parallel.expert` with the
+pieces a *trainable* MoE needs on top of raw dispatch:
+
+* :func:`router_topk` — softmax router with top-k selection (k ∈ {1, 2});
+  for k > 1 the kept gates are renormalized to sum to one (the classic
+  mixture), for k = 1 the raw top probability is the gate (Switch).
+* :func:`moe_ffn_routed` — one routed expert-FFN sublayer: router →
+  choice-major fused dispatch (one all_to_all round trip for all k
+  choices) → per-local-expert einsum with Megatron-TP row/column split →
+  combine → gate-weighted sum, plus the auxiliary statistics the loss and
+  the grading probe need (load-balance aux, router z, dropped fraction,
+  token entropy, per-expert usage).
+* :func:`moe_ffn_dense` — the dense-equivalent oracle: identical router
+  and gating math, but every expert computed on every token and selected
+  by mask — no expert axis, no all_to_all, no capacity.  With top-1
+  routing and no dropped tokens the routed path must match this
+  loss-for-loss to 1e-9 in float64 (tests/test_moe.py pins it).
+
+Cross-device accounting (the part that makes ``ep=1`` and ``ep>1``
+carvings bit-compatible): the load-balance loss is a *global* quantity —
+``E * sum_e f_e * p_e`` over the whole batch — but under expert
+parallelism each peer only sees its own batch shard.  The router stats
+are therefore psum'd over the ``expert`` axis *inside* the layer
+(``f_bar = psum(f_local / ep)``), and the model divides the aux term by
+``ep`` in the per-device loss so the legacy psum-transpose (which
+multiplies the replicated cotangent by the axis size) restores exactly
+the global-batch router gradient.  See ``model.make_moe_grad_fn``.
+"""
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..parallel.expert import moe_combine, moe_dispatch
+
+__all__ = ["router_topk", "moe_ffn_routed", "moe_ffn_dense"]
+
+
+def router_topk(x: jax.Array, wr: jax.Array, *, top_k: int):
+    """Softmax router: ``(logits, probs, topk_idx, topk_gate)``.
+
+    ``x`` is ``[T, D]`` tokens, ``wr`` the ``[D, E]`` router weight
+    (replicated over tp/sp/expert — every device routes its own tokens
+    over ALL experts).  For ``top_k > 1`` the selected gates are
+    renormalized to sum to one per token.
+    """
+    if top_k not in (1, 2):
+        raise ValueError(f"top_k must be 1 or 2, got {top_k!r}")
+    logits = x @ wr                                    # [T, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    gate, idx = lax.top_k(probs, top_k)                # [T, k] each
+    if top_k > 1:
+        gate = gate / jnp.sum(gate, axis=-1, keepdims=True)
+    return logits, probs, idx, gate
+
+
+def _router_stats(logits, probs, idx, keep, *, num_experts: int,
+                  axis: str) -> Dict[str, jax.Array]:
+    """Aux/grading statistics for one routed sublayer.
+
+    ``aux`` and ``usage`` are *globalized* over the expert-parallel axis
+    (psum of the ``1/ep``-scaled shard means), so their values are
+    replicated across ``ep`` peers and identical to the ``ep=1`` carving;
+    ``z``/``dropped``/``entropy`` stay shard-local means (the model's
+    ``/ep`` + outside-AD psum over ``expert`` turns them global — the
+    same treatment as the CE term).
+    """
+    ep = lax.axis_size(axis)
+    dt = probs.dtype
+    f_part = jnp.mean(
+        jax.nn.one_hot(idx[:, 0], num_experts, dtype=dt), axis=0) / ep
+    p_part = jnp.mean(probs, axis=0) / ep
+    f_bar = lax.psum(f_part, axis)                     # global dispatch frac
+    p_bar = lax.psum(p_part, axis)                     # global mean prob
+    aux = num_experts * jnp.sum(f_bar * p_bar)
+    z = jnp.mean(jax.nn.logsumexp(logits, axis=-1) ** 2)
+    dropped = 1.0 - jnp.mean(keep.astype(dt))
+    entropy = -jnp.mean(jnp.sum(probs * jnp.log(probs + 1e-20), axis=-1))
+    return {"aux": aux, "z": z, "dropped": dropped, "entropy": entropy,
+            "usage": f_bar}
+
+
+def _expert_einsum(h: jax.Array, w1: jax.Array, w2: jax.Array) -> jax.Array:
+    """Per-expert FFN on ``[E?, T, D]`` token blocks: column-split w1,
+    row-split w2, one psum over tp — the Megatron split *inside* every
+    expert, so tp and ep compose."""
+    u = jax.nn.gelu(jnp.einsum("etd,edf->etf", h, w1))
+    return lax.psum(jnp.einsum("etf,efd->etd", u, w2), "tp")
+
+
+def moe_ffn_routed(
+    x: jax.Array,                 # [T, D] this device's (post-LN) tokens
+    wr: jax.Array,                # [D, E] router
+    w1: jax.Array,                # [E_local, D, F/TP]
+    w2: jax.Array,                # [E_local, F/TP, D]
+    *,
+    num_experts: int,
+    top_k: int,
+    capacity: int,
+    axis: str = "expert",
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """One routed expert-FFN sublayer inside the composed shard_map.
+
+    Dispatch is choice-major fused (the ``moe_apply_topk`` scheme: one
+    all_to_all round trip carries all k choices, ``k * capacity`` pooled
+    slots per (source, expert) pair filled first-choice-first).  Returns
+    ``(y [T, D], stats)`` — ``y`` is the gate-weighted combined output
+    (dropped tokens contribute zero), ``stats`` the per-layer scalars of
+    :func:`_router_stats`.
+    """
+    T, D = x.shape
+    E, k = num_experts, top_k
+    n = lax.axis_size(axis)
+    e_local = E // n
+    logits, probs, idx, gate = router_topk(x, wr, top_k=k)
+    x_rep = jnp.tile(x, (k, 1))                        # [k*T, D]
+    flat_idx = idx.T.reshape(k * T)                    # choice-major
+    cap = k * capacity
+    expert_in, pos, keep = moe_dispatch(
+        x_rep, flat_idx, capacity=cap, axis=axis, num_experts=E)
+    h = expert_in.reshape(n, e_local, cap, D)
+    h = h.transpose(1, 0, 2, 3).reshape(e_local, n * cap, D)
+    o = _expert_einsum(h, w1, w2)                      # [E_local, n*cap, D]
+    o = o.reshape(e_local, n, cap, D).transpose(1, 0, 2, 3)
+    expert_out = o.reshape(n * e_local, cap, D)
+    out = moe_combine(expert_out, flat_idx, pos, keep, capacity=cap,
+                      axis=axis, num_experts=E)        # [k*T, D]
+    gates = gate.T[..., None].astype(x.dtype)          # [k, T, 1]
+    y = jnp.sum(out.reshape(k, T, D) * gates, axis=0)
+    return y, _router_stats(logits, probs, idx, keep,
+                            num_experts=E, axis=axis)
+
+
+def moe_ffn_dense(
+    x: jax.Array,                 # [T, D]
+    wr: jax.Array,                # [D, E]
+    w1: jax.Array,                # [E, D, F/TP] — ALL experts local
+    w2: jax.Array,                # [E, F/TP, D]
+    *,
+    top_k: int,
+    axis: str = "expert",
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Dense-equivalent oracle: every expert computed on every token,
+    selection by gate mask — the no-drop reference the routed path must
+    match.  Runs on an ``ep=1`` carving (the ``expert`` axis psums in the
+    stats are size-1 no-ops, keeping the two code paths symmetric).
+    """
+    E = w1.shape[0]
+    logits, probs, idx, gate = router_topk(x, wr, top_k=top_k)
+    o = _expert_einsum(jnp.broadcast_to(x, (E,) + x.shape), w1, w2)
+    sel = jax.nn.one_hot(idx, E, dtype=x.dtype)        # [T, k, E]
+    y = jnp.einsum("tke,etd,tk->td", sel, o, gate.astype(x.dtype))
+    keep = jnp.ones(idx.shape[0] * top_k, dtype=bool)  # dense never drops
+    return y, _router_stats(logits, probs, idx, keep,
+                            num_experts=E, axis=axis)

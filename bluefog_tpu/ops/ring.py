@@ -516,7 +516,7 @@ def online_softmax_merge(o, l, m, s, vt):
     corr = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - safe_m))
     l = l * corr + p.sum(axis=-1)
     o = o * corr[..., None] + jnp.einsum(
-        "bihj,bjhd->bihd", p, vt.astype(jnp.float32))
+        "bihj,bjhd->bihd", p, vt.astype(o.dtype))
     return o, l, m_new
 
 

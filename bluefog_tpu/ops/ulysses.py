@@ -137,14 +137,17 @@ def _jnp_local_attention(q, k, v, causal: bool, scale: float,
     Tk = k.shape[1]
     chunk = _chunk_len(Tk, max_chunk)
     C = Tk // chunk
-    qf = q.astype(jnp.float32) * scale
+    # accumulate in at least f32; f64 inputs keep f64 (the float64 oracle
+    # needs attention above the f32 noise floor)
+    acc = jnp.promote_types(q.dtype, jnp.float32)
+    qf = q.astype(acc) * scale
     kc = k.reshape(B, C, chunk, H, D).transpose(1, 0, 2, 3, 4)
     vc = v.reshape(B, C, chunk, H, D).transpose(1, 0, 2, 3, 4)
     q_pos = jnp.arange(Tq)
 
-    o0 = jnp.zeros(q.shape, jnp.float32)
-    l0 = jnp.zeros(q.shape[:3], jnp.float32)
-    m0 = jnp.full(q.shape[:3], -jnp.inf, jnp.float32)
+    o0 = jnp.zeros(q.shape, acc)
+    l0 = jnp.zeros(q.shape[:3], acc)
+    m0 = jnp.full(q.shape[:3], -jnp.inf, acc)
     if axis is not None:
         o0, l0, m0 = (lax.pcast(t, axis, to='varying')
                       for t in (o0, l0, m0))
@@ -152,7 +155,7 @@ def _jnp_local_attention(q, k, v, causal: bool, scale: float,
     def step(carry, inp):
         o, l, m = carry
         c, kt, vt = inp
-        s = jnp.einsum("bihd,bjhd->bihj", qf, kt.astype(jnp.float32))
+        s = jnp.einsum("bihd,bjhd->bihj", qf, kt.astype(acc))
         if causal:
             k_pos = c * chunk + jnp.arange(chunk)
             mask = q_pos[:, None, None] >= k_pos[None, None, :]

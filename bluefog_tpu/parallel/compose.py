@@ -1,7 +1,7 @@
 """Composed parallelism: gossip-DP x pipeline x tensor x Ulysses on ONE mesh.
 
 This is the production-shape carving ROADMAP item 4 names: the device mesh
-is split into four axes
+is split into five axes
 
 * ``rank``  — gossip data parallelism.  Each device neighbor-averages its
   full local parameter tree with its same-(stage, tp, sp) peers across DP
@@ -15,6 +15,10 @@ is split into four axes
   (column-split qkv/up, row-split out/down, one ``psum`` per sublayer).
 * ``sp``    — Ulysses sequence parallelism (:func:`..ops.ulysses_attention`:
   two ``all_to_all``s re-shard heads <-> sequence around local attention).
+* ``expert`` — expert parallelism for routed MoE (``ep``, 1 by default):
+  capacity-based dispatch/combine ``all_to_all``s
+  (:mod:`..parallel.expert`) shard the experts of the routed LM in
+  :mod:`bluefog_tpu.moe`; like pp/tp/sp it is intra-slice by construction.
 
 :func:`compose_parallelism` validates the carving eagerly (sizes must
 multiply to the mesh size, the wire codec applies to gossip permutes only,
@@ -52,7 +56,8 @@ from ..schedule import CommSchedule, compile_topology
 from . import context as _ctx
 from .pipeline import pipeline_apply
 
-AXES: Tuple[str, str, str, str] = ("rank", "stage", "tp", "sp")
+AXES: Tuple[str, str, str, str, str] = ("rank", "stage", "tp", "sp",
+                                        "expert")
 
 __all__ = [
     "AXES", "Mesh3D", "compose_parallelism", "make_train_step",
@@ -63,12 +68,17 @@ __all__ = [
 
 @dataclasses.dataclass(frozen=True)
 class Mesh3D:
-    """A validated 4-axis carving of the device mesh.
+    """A validated 5-axis carving of the device mesh.
 
-    ``mesh`` has axes ``("rank", "stage", "tp", "sp")`` with the gossip-DP
-    axis outermost; ``topology``/``schedule`` describe the gossip graph
-    over the ``dp`` DP leaders (NOT over all ranks — that is the point);
-    ``wire`` is the optional codec gossip bytes travel in on the wire.
+    ``mesh`` has axes ``("rank", "stage", "tp", "sp", "expert")`` with the
+    gossip-DP axis outermost; ``topology``/``schedule`` describe the gossip
+    graph over the ``dp`` DP leaders (NOT over all ranks — that is the
+    point); ``wire`` is the optional codec gossip bytes travel in on the
+    wire.  The ``expert`` axis (``ep``, innermost, 1 by default) shards
+    routed-MoE experts: its all_to_alls stay intra-slice by construction —
+    see :mod:`bluefog_tpu.moe`.  ``num_experts``/``capacity_factor`` are
+    carried as carving metadata so tools (lm_bench, autotune, flight
+    bundles) grade the MoE shape alongside the mesh shape.
     """
     mesh: Mesh
     dp: int
@@ -79,19 +89,22 @@ class Mesh3D:
     is_weighted: bool
     schedule: CommSchedule
     wire: Optional[str] = None
+    ep: int = 1
+    num_experts: Optional[int] = None
+    capacity_factor: Optional[float] = None
 
     @property
     def size(self) -> int:
-        return self.dp * self.pp * self.tp * self.sp
+        return self.dp * self.pp * self.tp * self.sp * self.ep
 
     @property
     def slice_size(self) -> int:
         """Devices per DP replica — everything inside is intra-slice."""
-        return self.pp * self.tp * self.sp
+        return self.pp * self.tp * self.sp * self.ep
 
     @property
     def spec(self) -> P:
-        """One leading device axis collapsed over all four mesh axes."""
+        """One leading device axis collapsed over all five mesh axes."""
         return P(AXES)
 
     def leader_degree(self) -> int:
@@ -118,6 +131,8 @@ class Mesh3D:
         """JSON-ready summary for bench artifacts / flight bundles."""
         return {
             "dp": self.dp, "pp": self.pp, "tp": self.tp, "sp": self.sp,
+            "ep": self.ep, "num_experts": self.num_experts,
+            "capacity_factor": self.capacity_factor,
             "n_chips": self.size,
             "topology": self.topology.graph.get(
                 "name", f"digraph<{self.topology.number_of_nodes()}>"),
@@ -133,17 +148,29 @@ def compose_parallelism(
     pp: int = 1,
     tp: int = 1,
     sp: int = 1,
+    ep: int = 1,
     *,
+    num_experts: Optional[int] = None,
+    capacity_factor: Optional[float] = None,
     devices: Optional[Any] = None,
     topology: Union[nx.DiGraph, Callable[[int], nx.DiGraph], None] = None,
     weighted: bool = True,
     wire: Optional[str] = None,
 ) -> Mesh3D:
-    """Carve the device mesh into (gossip-DP, PP, TP, SP) and validate it.
+    """Carve the device mesh into (gossip-DP, PP, TP, SP, EP), validated.
 
     Args:
-      dp, pp, tp, sp: axis sizes; their product must equal the device
-        count exactly (pass ``devices=`` to carve a sub-mesh).
+      dp, pp, tp, sp, ep: axis sizes; their product must equal the device
+        count exactly (pass ``devices=`` to carve a sub-mesh).  ``ep``
+        shards routed-MoE experts (``bluefog_tpu.moe``) and stays
+        intra-slice: the slice-major device sort keeps gossip-DP outermost.
+      num_experts: total routed experts in the model this carving will run.
+        Required when ``ep > 1`` (each expert-parallel peer owns
+        ``num_experts // ep`` experts, so ``num_experts % ep == 0``);
+        optional metadata otherwise.
+      capacity_factor: expert capacity factor metadata, surfaced by
+        ``describe()`` and the bench artifacts (the model config holds the
+        operative value — see ``moe.MoELMConfig``).
       devices: explicit device list; defaults to the context's devices
         (``bf.init`` order) or ``jax.devices()``.  On multislice hardware
         devices are re-ordered slice-major so the DP axis — the only one
@@ -156,15 +183,37 @@ def compose_parallelism(
       weighted: compile the graph's own mixing weights (vs the reference's
         uniform ``1/(in_degree+1)``).
       wire: DCN wire codec for the gossip permutes ONLY (``"bf16"``,
-        ``"fp8"``, ``"fp8@64"``, ... — see ``ops.collectives``).  PP/TP/SP
-        collectives are intra-slice and never compressed.  Requires
-        ``dp > 1``: with a single replica there is no gossip edge to
-        compress, so a codec would silently grade nothing.
+        ``"fp8"``, ``"fp8@64"``, ... — see ``ops.collectives``).
+        PP/TP/SP/EP collectives are intra-slice and never compressed.
+        Requires ``dp > 1``: with a single replica there is no gossip edge
+        to compress, so a codec would silently grade nothing.
     """
-    for name, v in (("dp", dp), ("pp", pp), ("tp", tp), ("sp", sp)):
+    for name, v in (("dp", dp), ("pp", pp), ("tp", tp), ("sp", sp),
+                    ("ep", ep)):
         if not isinstance(v, (int, np.integer)) or v < 1:
             raise ValueError(f"axis size {name}={v!r} must be a positive int")
-    n = dp * pp * tp * sp
+    n = dp * pp * tp * sp * ep
+    if num_experts is not None and (
+            not isinstance(num_experts, (int, np.integer))
+            or num_experts < 1):
+        raise ValueError(
+            f"num_experts={num_experts!r} must be a positive int")
+    if ep > 1:
+        if num_experts is None:
+            raise ValueError(
+                f"ep={ep} carves an expert-parallel axis but num_experts "
+                "was not given; each expert peer owns num_experts // ep "
+                "experts, so the carving contract needs the total")
+        if num_experts % ep:
+            raise ValueError(
+                f"num_experts ({num_experts}) % ep ({ep}) != 0: each "
+                "expert-parallel peer owns a contiguous block of "
+                "num_experts // ep experts")
+    if capacity_factor is not None and not (
+            isinstance(capacity_factor, (int, float, np.floating))
+            and float(capacity_factor) > 0):
+        raise ValueError(
+            f"capacity_factor={capacity_factor!r} must be a positive number")
 
     if devices is None:
         devices = list(np.ravel(_ctx.devices())) if _ctx.is_initialized() \
@@ -172,10 +221,10 @@ def compose_parallelism(
     devices = list(np.ravel(np.asarray(devices, dtype=object)))
     if len(devices) != n:
         raise ValueError(
-            f"carving dp*pp*tp*sp = {dp}*{pp}*{tp}*{sp} = {n} does not "
-            f"match the device count ({len(devices)}); every chip must "
-            "belong to exactly one (replica, stage, tp, sp) coordinate — "
-            "pass devices= to carve a sub-mesh")
+            f"carving dp*pp*tp*sp*ep = {dp}*{pp}*{tp}*{sp}*{ep} = {n} does "
+            f"not match the device count ({len(devices)}); every chip must "
+            "belong to exactly one (replica, stage, tp, sp, expert) "
+            "coordinate — pass devices= to carve a sub-mesh")
     # slice-major order: gossip (the only DCN-crossing axis) gets the
     # outermost position, so cross-slice traffic is exactly the DP permutes
     devices.sort(key=lambda d: (getattr(d, "slice_index", 0) or 0,
@@ -203,11 +252,15 @@ def compose_parallelism(
             "replicas only (PP/TP/SP peers hold different shards and must "
             "not be mixed)")
 
-    mesh = Mesh(np.asarray(devices, dtype=object).reshape(dp, pp, tp, sp),
-                AXES)
-    m = Mesh3D(mesh=mesh, dp=dp, pp=pp, tp=tp, sp=sp, topology=topo,
+    mesh = Mesh(
+        np.asarray(devices, dtype=object).reshape(dp, pp, tp, sp, ep),
+        AXES)
+    m = Mesh3D(mesh=mesh, dp=dp, pp=pp, tp=tp, sp=sp, ep=ep, topology=topo,
                is_weighted=weighted,
-               schedule=compile_topology(topo, weighted), wire=wire)
+               schedule=compile_topology(topo, weighted), wire=wire,
+               num_experts=num_experts,
+               capacity_factor=(None if capacity_factor is None
+                                else float(capacity_factor)))
     if _ctx.is_initialized():
         _ctx.set_compose(m)
     return m
@@ -412,10 +465,10 @@ def init_lm_params(cfg: LMConfig, m: Mesh3D, seed: int = 0) -> Any:
     }
     shared = {"embed": w(cfg.vocab, D), "head": w(D, cfg.vocab)}
 
-    # flat device i = ((r*pp + s)*tp + t)*sp + u
-    r, s, t, u = np.unravel_index(np.arange(m.size),
-                                  (m.dp, m.pp, m.tp, m.sp))
-    del r, u
+    # flat device i = (((r*pp + s)*tp + t)*sp + u)*ep + e
+    r, s, t, u, e = np.unravel_index(np.arange(m.size),
+                                     (m.dp, m.pp, m.tp, m.sp, m.ep))
+    del r, u, e
     return {
         "blocks": {k: jnp.asarray(v[s, t]) for k, v in blocks.items()},
         "shared": {k: jnp.asarray(np.broadcast_to(v, (m.size,) + v.shape))
@@ -434,8 +487,8 @@ def make_lm_batch(cfg: LMConfig, m: Mesh3D, seed: int = 0,
         else (m.dp, steps, cfg.micro, cfg.batch, cfg.seq_len)
     data = rng.integers(0, cfg.vocab, size=shape).astype(np.int32)
     Tl = cfg.seq_len // m.sp
-    r, _, _, u = np.unravel_index(np.arange(m.size),
-                                  (m.dp, m.pp, m.tp, m.sp))
+    r, _, _, u, _ = np.unravel_index(np.arange(m.size),
+                                     (m.dp, m.pp, m.tp, m.sp, m.ep))
     per_dev = np.stack([data[ri][..., ui * Tl:(ui + 1) * Tl]
                         for ri, ui in zip(r, u)])
     return jnp.asarray(per_dev)

@@ -335,7 +335,7 @@ def reinit(world_size: int, *,
             f"world size {world_size} is not a multiple of the active "
             f"carving's slice size {old_compose.slice_size} "
             f"(pp={old_compose.pp} tp={old_compose.tp} "
-            f"sp={old_compose.sp})")
+            f"sp={old_compose.sp} ep={old_compose.ep})")
     _rebootstrap_distributed(world_size)
 
     from ..utils import metrics as _metrics
@@ -366,8 +366,10 @@ def reinit(world_size: int, *,
         from . import compose as _compose
         _compose.compose_parallelism(
             world_size // old_compose.slice_size, old_compose.pp,
-            old_compose.tp, old_compose.sp, devices=devs_list,
-            wire=old_compose.wire)
+            old_compose.tp, old_compose.sp, old_compose.ep,
+            num_experts=old_compose.num_experts,
+            capacity_factor=old_compose.capacity_factor,
+            devices=devs_list, wire=old_compose.wire)
 
     # the old world's membership registry (and its pristine baseline) is
     # meaningless against the new mesh — re-baseline from scratch

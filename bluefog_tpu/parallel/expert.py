@@ -1,21 +1,30 @@
 """Expert parallelism: capacity-based MoE dispatch over a mesh axis.
 
 Beyond the reference (data-parallel only, SURVEY.md §2.4): each device along
-the ``expert`` axis owns one expert; tokens are routed to their expert's
-device with one ``lax.all_to_all``, transformed, and routed back with a
-second.  Dispatch is the standard static-capacity scheme (XLA needs static
-shapes): each (source device, expert) pair gets ``capacity`` slots, tokens
-beyond capacity are dropped (their combined output is zero — multiply by the
-router gate outside, as usual for MoE).
+the ``expert`` axis owns ``num_experts / axis_size`` experts (one by
+default); tokens are routed to their expert's device with one
+``lax.all_to_all``, transformed, and routed back with a second.  Dispatch is
+the standard static-capacity scheme (XLA needs static shapes): each (source
+device, expert) pair gets ``capacity`` slots, tokens beyond capacity are
+dropped (their combined output is zero — multiply by the router gate
+outside, as usual for MoE).
 
     y = moe_apply(x, expert_idx, expert_fn, params, capacity=C, axis="expert")
+
+With ``num_experts = E > axis_size`` each device owns a contiguous block of
+``E_local = E // axis_size`` experts (device d owns experts
+``[d*E_local, (d+1)*E_local)``) and the dispatch buffer carries
+``E_local * capacity`` slots per source — the layout the composed 5-axis
+carving (``parallel.compose``) and the routed-MoE reference LM
+(``bluefog_tpu.moe``) build on.
 """
 from __future__ import annotations
 
-from typing import Any, Callable, Tuple
+from typing import Any, Callable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 __all__ = ["moe_dispatch", "moe_combine", "moe_apply", "moe_apply_topk",
@@ -24,11 +33,53 @@ __all__ = ["moe_dispatch", "moe_combine", "moe_apply", "moe_apply_topk",
 Axis = str
 
 
+def _resolve_num_experts(axis: Axis, num_experts: Optional[int]) -> int:
+    n = lax.axis_size(axis)
+    E = n if num_experts is None else num_experts
+    if not isinstance(E, (int, np.integer)) or E < 1:
+        raise ValueError(
+            f"moe num_experts={num_experts!r} must be a positive int")
+    if E % n:
+        raise ValueError(
+            f"moe num_experts ({E}) must be a multiple of the '{axis}' "
+            f"axis size ({n}): each device owns a contiguous block of "
+            "num_experts // axis_size experts")
+    return int(E)
+
+
 def _routing(expert_idx: jax.Array, num_experts: int, capacity: int):
-    """Per-token slot assignment: (slot position within expert, kept?)."""
+    """Per-token slot assignment: (slot position within expert, kept?).
+
+    Guards (eager, at trace time where possible):
+
+    * ``capacity`` must be a positive static int — a zero/negative capacity
+      would make every token silently dropped (or index ``capacity - 1``
+      garbage) downstream;
+    * ``expert_idx`` out of ``[0, num_experts)`` raises
+      ``moe_routing_expert_idx_out_of_range`` when the indices are concrete;
+      under tracing (where values are unknowable) out-of-range tokens are
+      masked to *dropped* instead of producing garbage one-hots.
+    """
+    if not isinstance(capacity, (int, np.integer)) or capacity <= 0:
+        raise ValueError(
+            "moe_routing_invalid_capacity: capacity must be a positive "
+            f"static int, got {capacity!r}; a non-positive capacity drops "
+            "every token (capacity = ceil(capacity_factor * tokens / "
+            "num_experts) — raise the capacity factor)")
+    try:                                 # concrete (numpy / committed) idx:
+        idx = np.asarray(expert_idx)     # eager range check with a named
+    except Exception:                    # error; tracers fall through
+        idx = None
+    if idx is not None and idx.size and (idx.min() < 0
+                                         or idx.max() >= num_experts):
+        raise ValueError(
+            "moe_routing_expert_idx_out_of_range: expert_idx must lie in "
+            f"[0, {num_experts}), got min={idx.min()} max={idx.max()}; "
+            "out-of-range indices would silently produce garbage one-hots")
+    in_range = (expert_idx >= 0) & (expert_idx < num_experts)
     onehot = jax.nn.one_hot(expert_idx, num_experts, dtype=jnp.int32)  # [T,E]
     pos = jnp.sum((jnp.cumsum(onehot, axis=0) - 1) * onehot, axis=1)   # [T]
-    keep = pos < capacity
+    keep = (pos < capacity) & in_range
     return pos, keep
 
 
@@ -38,45 +89,55 @@ def moe_dispatch(
     *,
     capacity: int,
     axis: Axis = "expert",
+    num_experts: Optional[int] = None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Route tokens to expert owners.
 
-    Returns ``(expert_in [n_src, capacity, D], pos, keep)``: on the device
-    owning expert e, ``expert_in[s]`` holds the tokens source device s routed
-    to e (zeros in unused slots); ``pos``/``keep`` are needed by
-    :func:`moe_combine` for the return path.
+    Returns ``(expert_in [n_src * E_local, capacity, D], pos, keep)``: on
+    the device owning experts ``[d*E_local, (d+1)*E_local)``,
+    ``expert_in.reshape(n_src, E_local, capacity, D)[s, e]`` holds the
+    tokens source device s routed to local expert e (zeros in unused
+    slots); ``pos``/``keep`` are needed by :func:`moe_combine` for the
+    return path.  With the default ``num_experts=None`` (one expert per
+    device, ``E_local == 1``) the first axis is simply ``n_src``.
     """
     n = lax.axis_size(axis)
+    E = _resolve_num_experts(axis, num_experts)
     T, D = x.shape
-    pos, keep = _routing(expert_idx, n, capacity)
+    pos, keep = _routing(expert_idx, E, capacity)
     slot = jnp.where(keep, pos, capacity - 1)
-    buf = jnp.zeros((n, capacity, D), x.dtype)
+    buf = jnp.zeros((E, capacity, D), x.dtype)
     buf = buf.at[expert_idx, slot].add(
         x * keep[:, None].astype(x.dtype))                 # [E, C, D]
-    # device d's block e -> device e's block d (shape-preserving swap:
-    # tiled all_to_all with split_axis == concat_axis)
+    # device d's expert block e -> device e's source block d (shape-
+    # preserving swap: tiled all_to_all with split_axis == concat_axis;
+    # dim 0 splits into n blocks of E_local experts each)
     swapped = lax.all_to_all(buf, axis, split_axis=0, concat_axis=0,
-                             tiled=True)                   # [n_src, C, D]
+                             tiled=True)          # [n_src * E_local, C, D]
+    del n
     return swapped, pos, keep
 
 
 def moe_combine(
-    expert_out: jax.Array,       # [n_src, capacity, D] transformed tokens
+    expert_out: jax.Array,       # [n_src * E_local, capacity, D]
     expert_idx: jax.Array,
     pos: jax.Array,
     keep: jax.Array,
     *,
     capacity: int,
     axis: Axis = "expert",
+    num_experts: Optional[int] = None,
 ) -> jax.Array:
     """Inverse of :func:`moe_dispatch`: bring each token's output home.
 
     Dropped tokens come back as zeros.
     """
+    E = _resolve_num_experts(axis, num_experts)
     back = lax.all_to_all(expert_out, axis,
-                          split_axis=0, concat_axis=0, tiled=True)  # [E, C, D]
+                          split_axis=0, concat_axis=0, tiled=True)  # [E,C,D]
     slot = jnp.where(keep, pos, capacity - 1)
-    y = back[expert_idx, slot]
+    safe_idx = jnp.clip(expert_idx, 0, E - 1)
+    y = back[safe_idx, slot]
     return y * keep[:, None].astype(y.dtype)
 
 
@@ -88,20 +149,25 @@ def moe_apply(
     *,
     capacity: int,
     axis: Axis = "expert",
+    num_experts: Optional[int] = None,
 ) -> jax.Array:
-    """Dispatch -> this device's expert -> combine (one MoE layer).
+    """Dispatch -> this device's expert(s) -> combine (one MoE layer).
 
-    ``expert_fn(params, tokens)`` receives the flattened ``[n_src * capacity,
-    D]`` token matrix (zeros in unused slots) and must preserve its shape.
+    ``expert_fn(params, tokens)`` receives the flattened ``[n_src * E_local
+    * capacity, D]`` token matrix (zeros in unused slots) and must preserve
+    its shape.  With ``E_local > 1`` reshape to ``[n_src, E_local,
+    capacity, D]`` inside ``expert_fn`` to address per-expert weights (the
+    routed LM in :mod:`bluefog_tpu.moe` does exactly this).
     """
     expert_in, pos, keep = moe_dispatch(
-        x, expert_idx, capacity=capacity, axis=axis)
-    n_src, cap, D = expert_in.shape
-    expert_out = expert_fn(expert_params, expert_in.reshape(n_src * cap, D))
-    if expert_out.shape != (n_src * cap, D):
+        x, expert_idx, capacity=capacity, axis=axis, num_experts=num_experts)
+    rows, cap, D = expert_in.shape
+    expert_out = expert_fn(expert_params, expert_in.reshape(rows * cap, D))
+    if expert_out.shape != (rows * cap, D):
         raise ValueError("expert_fn must preserve [tokens, D] shape")
-    return moe_combine(expert_out.reshape(n_src, cap, D), expert_idx, pos,
-                       keep, capacity=capacity, axis=axis)
+    return moe_combine(expert_out.reshape(rows, cap, D), expert_idx, pos,
+                       keep, capacity=capacity, axis=axis,
+                       num_experts=num_experts)
 
 
 def moe_apply_topk(
@@ -114,6 +180,7 @@ def moe_apply_topk(
     capacity: int,
     axis: Axis = "expert",
     fused: bool = True,
+    num_experts: Optional[int] = None,
 ) -> jax.Array:
     """Top-k routed MoE layer (k=2 is the classic mixture): the k choices
     are stacked into ONE dispatch/combine — a single all_to_all round trip
@@ -140,7 +207,8 @@ def moe_apply_topk(
         y = jnp.zeros_like(x)
         for j in range(k):
             out = moe_apply(x, topk_idx[:, j], expert_fn, expert_params,
-                            capacity=capacity, axis=axis)
+                            capacity=capacity, axis=axis,
+                            num_experts=num_experts)
             y = y + out * topk_gate[:, j:j + 1].astype(x.dtype)
         return y
     # choice-major virtual tokens [c0t0.. c0tN, c1t0..]: first choices claim
@@ -148,7 +216,8 @@ def moe_apply_topk(
     x_rep = jnp.tile(x, (k, 1))                          # [k*T, D]
     flat_idx = topk_idx.T.reshape(k * T)
     out = moe_apply(x_rep, flat_idx, expert_fn, expert_params,
-                    capacity=k * capacity, axis=axis)    # one round trip
+                    capacity=k * capacity, axis=axis,
+                    num_experts=num_experts)             # one round trip
     gates = topk_gate.T[..., None].astype(x.dtype)       # [k, T, 1]
     return jnp.sum(out.reshape(k, T, D) * gates, axis=0)
 

@@ -31,9 +31,11 @@ MODULES = [
     ("bluefog_tpu.parallel.windows", "Window registry (named windows)"),
     ("bluefog_tpu.parallel.pipeline", "Pipeline parallelism"),
     ("bluefog_tpu.parallel.compose",
-     "Composed parallelism (gossip-DP x PP x TP x Ulysses)"),
+     "Composed parallelism (gossip-DP x PP x TP x Ulysses x EP)"),
     ("bluefog_tpu.parallel.tensor_parallel", "Tensor parallelism"),
     ("bluefog_tpu.parallel.expert", "Expert (MoE) parallelism"),
+    ("bluefog_tpu.moe.layers", "Routed-MoE layers (router + expert FFN)"),
+    ("bluefog_tpu.moe.model", "Routed-MoE reference LM"),
     ("bluefog_tpu.checkpoint", "Checkpointing (orbax, elastic, async)"),
     ("bluefog_tpu.serve.engine", "Serving engine (prefill + fused decode)"),
     ("bluefog_tpu.serve.kv_cache", "Slotted paged KV cache"),

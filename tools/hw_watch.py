@@ -212,6 +212,16 @@ def _battery_steps(tag: str, stage: int = 0) -> list:
                       [py, lm, "--pallas", "--out",
                        os.path.join(m, f"lm_bench_pallas_{tag}.json")],
                       2400, None, None))
+        # the routed-MoE row: the 5-axis carve (dp=2 x pp=2 x ep=2 on the
+        # same 8 chips) — tokens/s + routing health (entropy, dropped
+        # fraction, aux/z) banked alongside the dense rows; the AOT byte
+        # split in the artifact proves expert all_to_alls stayed on ICI
+        steps.append(("lm_bench_moe",
+                      [py, lm, "--moe", "--dp", "2", "--pp", "2",
+                       "--tp", "1", "--sp", "1", "--ep", "2",
+                       "--experts", "4", "--out",
+                       os.path.join(m, f"lm_bench_moe_{tag}.json")],
+                      2400, None, None))
     sb = os.path.join(REPO, "tools", "serve_bench.py")
     if os.path.exists(sb):
         # the serving grader on the same 8 chips: 2 training replicas
@@ -316,6 +326,12 @@ def _rehearsal_steps(tag: str) -> list:
          [py, os.path.join(REPO, "tools", "lm_bench.py"),
           "--virtual-cpu", "--smoke", "--pallas",
           "--out", os.path.join(m, f"lm_bench_pallas_{tag}.json")], 900,
+         None, None),
+        ("lm_bench_moe",
+         [py, os.path.join(REPO, "tools", "lm_bench.py"),
+          "--virtual-cpu", "--smoke", "--moe", "--dp", "2", "--pp", "2",
+          "--tp", "1", "--sp", "1", "--ep", "2", "--experts", "4",
+          "--out", os.path.join(m, f"lm_bench_moe_{tag}.json")], 900,
          None, None),
         ("serve_bench",
          [py, os.path.join(REPO, "tools", "serve_bench.py"),

@@ -23,13 +23,26 @@ sentinel) and grades it on every axis the paper's claim rides on:
   flight bundle (``--flight-dir``) tools/postmortem.py must blame
   correctly — the tier-1 test drives exactly that.
 
-Emits a ``bluefog-lm-bench-1`` JSON artifact (last stdout line, and
-``--out``).  ``--aot-only`` skips execution and fills the byte/codec
-fields only — the CPU AOT proofs (tests/test_lm_bench.py) use it to pin
-that cross-slice gossip bytes follow DP-leader degree, not rank count.
+``--moe`` swaps the dense LM for the routed-MoE reference model
+(``bluefog_tpu.moe``) on the full 5-axis carve (``--ep`` adds the expert
+axis; ``--experts``/``--top-k``/``--capacity-factor`` size the routing,
+defaulting from the ``BLUEFOG_MOE_*`` env knobs) and grades routing
+health on top of the throughput rows: mean router entropy, dropped-token
+fraction, load-balance aux, per-expert usage entropy — read off the
+forward-only probe OUTSIDE the timed window, so the graded step stays
+the production step.
+
+Emits a ``bluefog-lm-bench-2`` JSON artifact (last stdout line, and
+``--out``; schema 2 adds the nullable ``moe`` block).  ``--aot-only``
+skips execution and fills the byte/codec fields only — the CPU AOT
+proofs (tests/test_lm_bench.py) use it to pin that cross-slice gossip
+bytes follow DP-leader degree, not rank count (and, with ``--moe``,
+that expert all_to_alls never cross a slice).
 
 Run:    python tools/lm_bench.py --dp 4 --pp 2 --tp 2 --wire fp8@64 --out ...
 Smoke:  python tools/lm_bench.py --virtual-cpu --smoke
+MoE:    python tools/lm_bench.py --virtual-cpu --smoke --moe --ep 2 \\
+            --experts 4
 """
 import argparse
 import importlib.util
@@ -42,7 +55,7 @@ import time
 REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
 sys.path.insert(0, REPO)
 
-SCHEMA = "bluefog-lm-bench-1"
+SCHEMA = "bluefog-lm-bench-2"
 
 
 def _load_tool(name):
@@ -63,6 +76,18 @@ def main():
     ap.add_argument("--pp", type=int, default=2, help="pipeline stages")
     ap.add_argument("--tp", type=int, default=2, help="tensor-parallel ways")
     ap.add_argument("--sp", type=int, default=1, help="Ulysses sequence ways")
+    ap.add_argument("--moe", action="store_true",
+                    help="grade the routed-MoE reference LM instead of the "
+                         "dense one (enables the expert axis)")
+    ap.add_argument("--ep", type=int, default=1,
+                    help="expert-parallel ways (requires --moe)")
+    ap.add_argument("--experts", type=int, default=None,
+                    help="total experts (default BLUEFOG_MOE_EXPERTS or 8)")
+    ap.add_argument("--top-k", type=int, default=None,
+                    help="router top-k, 1 or 2 (default BLUEFOG_MOE_TOPK)")
+    ap.add_argument("--capacity-factor", type=float, default=None,
+                    help="expert capacity factor (default "
+                         "BLUEFOG_MOE_CAPACITY_FACTOR or 1.25)")
     ap.add_argument("--wire", default=None,
                     help="gossip DCN codec (bf16 / fp8 / fp8@64 / int8@...)")
     ap.add_argument("--seq", type=int, default=None,
@@ -100,7 +125,11 @@ def main():
     ap.add_argument("--allow-cpu", action="store_true")
     args = ap.parse_args()
 
-    n_chips = args.dp * args.pp * args.tp * args.sp
+    if args.ep > 1 and not args.moe:
+        print("refusing: --ep > 1 needs --moe (the dense LM has no expert "
+              "axis)", file=sys.stderr)
+        sys.exit(2)
+    n_chips = args.dp * args.pp * args.tp * args.sp * args.ep
     if args.virtual_cpu:
         flags = os.environ.get("XLA_FLAGS", "")
         if "host_platform_device_count" not in flags:
@@ -146,18 +175,40 @@ def main():
     bf.init(platform="cpu" if args.virtual_cpu else None)
     if bf.size() != n_chips:
         raise SystemExit(
-            f"carving dp*pp*tp*sp = {n_chips} != device count {bf.size()}")
+            f"carving dp*pp*tp*sp*ep = {n_chips} != device count "
+            f"{bf.size()}")
+
+    if args.moe:
+        from bluefog_tpu import moe as bfmoe
+        overrides = {}
+        if args.experts is not None:
+            overrides["num_experts"] = args.experts
+        if args.top_k is not None:
+            overrides["top_k"] = args.top_k
+        if args.capacity_factor is not None:
+            overrides["capacity_factor"] = args.capacity_factor
+        cfg = bfmoe.MoELMConfig.from_env(
+            vocab=vocab, d_model=d_model, heads=heads, layers=layers,
+            seq_len=seq, micro=micro, batch=batch, **overrides)
+        carve_kw = {"num_experts": cfg.num_experts,
+                    "capacity_factor": cfg.capacity_factor}
+    else:
+        cfg = compose.LMConfig(
+            vocab=vocab, d_model=d_model, heads=heads, layers=layers,
+            seq_len=seq, micro=micro, batch=batch)
+        carve_kw = {}
 
     m = compose.compose_parallelism(
-        args.dp, args.pp, args.tp, args.sp, wire=args.wire)
-    cfg = compose.LMConfig(
-        vocab=vocab, d_model=d_model, heads=heads, layers=layers,
-        seq_len=seq, micro=micro, batch=batch)
+        args.dp, args.pp, args.tp, args.sp, args.ep, wire=args.wire,
+        **carve_kw)
     cfg.validate(m)
 
     def build_step(mesh3d):
-        grad_fn = compose.make_lm_grad_fn(cfg, mesh3d, remat=args.remat,
-                                          use_pallas=args.pallas)
+        if args.moe:
+            grad_fn = bfmoe.make_moe_grad_fn(cfg, mesh3d, remat=args.remat)
+        else:
+            grad_fn = compose.make_lm_grad_fn(cfg, mesh3d, remat=args.remat,
+                                              use_pallas=args.pallas)
         return compose.make_train_step(
             mesh3d, grad_fn, optax.adam(5e-3),
             delayed=not args.no_delayed,
@@ -166,9 +217,13 @@ def main():
             metrics_every_k=2, metrics_warmup=2)
 
     step, strategy = build_step(m)
-    params = compose.init_lm_params(cfg, m)
+    if args.moe:
+        params = bfmoe.init_moe_params(cfg, m)
+        toks = bfmoe.make_moe_batch(cfg, m)
+    else:
+        params = compose.init_lm_params(cfg, m)
+        toks = compose.make_lm_batch(cfg, m)
     state = bfopt.init_distributed(strategy, params)
-    toks = compose.make_lm_batch(cfg, m)
     params = compose.device_put(m, params)
 
     # -- AOT byte attribution (pre-opt StableHLO: states the wire dtypes
@@ -184,7 +239,8 @@ def main():
             codecs.append(args.wire)
         for w in codecs:
             mw = compose.compose_parallelism(
-                args.dp, args.pp, args.tp, args.sp, wire=w)
+                args.dp, args.pp, args.tp, args.sp, args.ep, wire=w,
+                **carve_kw)
             sw_step, sw_strategy = build_step(mw)
             sw_state = bfopt.init_distributed(
                 sw_strategy, jax.tree.map(np.asarray, params))
@@ -195,7 +251,8 @@ def main():
                           "dcn_dtypes": st["dcn_dtypes"],
                           "ici_bytes": st["ici_bytes"]})
         compose.compose_parallelism(       # restore the graded carving as
-            args.dp, args.pp, args.tp, args.sp, wire=args.wire)  # active
+            args.dp, args.pp, args.tp, args.sp, args.ep,         # active
+            wire=args.wire, **carve_kw)
 
     tokens_per_step = args.dp * micro * batch * seq
     flops_per_token = cfg.flops_per_token()
@@ -225,7 +282,23 @@ def main():
         "chaos": args.chaos,
         "straggler": None,
         "flight_bundle": None,
+        "moe": None,
     }
+    if args.moe:
+        doc["moe"] = {
+            "num_experts": cfg.num_experts,
+            "top_k": cfg.top_k,
+            "ep": m.ep,
+            "capacity_factor": cfg.capacity_factor,
+            "capacity": cfg.capacity(m),
+            "n_active_params": cfg.n_active_params,
+            # routing health (filled by the probe after the timed run)
+            "routing_entropy": None,
+            "dropped_fraction": None,
+            "aux_loss": None,
+            "z_loss": None,
+            "usage_entropy": None,
+        }
 
     if args.aot_only:
         _emit(doc, args.out)
@@ -301,6 +374,21 @@ def main():
     doc["ok"] = bool(doc["loss_decreased"]
                      and doc["invariants"]["donation_intact"]
                      and doc["invariants"]["retraces_after_warmup"] == 0)
+
+    if args.moe:
+        # routing health off the forward-only probe: runs OUTSIDE the timed
+        # window on the final params, so the graded step stays untouched
+        probe = bfmoe.make_moe_probe(cfg, m)
+        health = probe(params, toks)
+        doc["moe"].update({
+            "routing_entropy": round(float(health["token_entropy"]), 4),
+            "dropped_fraction": round(float(health["dropped_fraction"]), 4),
+            "aux_loss": round(float(health["aux_loss"]), 4),
+            "z_loss": round(float(health["z_loss"]), 4),
+            "usage_entropy": round(float(health["usage_entropy"]), 4),
+        })
+        doc["ok"] = bool(doc["ok"]
+                         and 0.0 <= doc["moe"]["dropped_fraction"] <= 1.0)
 
     if args.chaos:
         stragglers = bfdiag.detect_stragglers()

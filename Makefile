@@ -7,7 +7,8 @@ PY ?= python
 .PHONY: test test-fast test_basic test_ops test_win_ops test_optimizer \
 	test_hier test_native test_examples verify native clean hw-watch \
 	obs-smoke chaos-smoke overlap-smoke postmortem-smoke pod-smoke \
-	autotune-smoke elastic-smoke lm-smoke moe-smoke serve-smoke \
+	autotune-smoke elastic-smoke lm-smoke moe-smoke moe-fast-smoke \
+	serve-smoke \
 	serve-fast-smoke \
 	async-smoke regrow-smoke
 
@@ -188,6 +189,33 @@ moe-smoke:
 		assert set(w['dcn']) == {'collective_permute'} and \
 		w['dcn_dtypes'] == ['bf16'], w; \
 		print('moe-smoke OK')"
+
+# dropless MoE fast-path smoke: the permutation/oracle battery (sort-based
+# grouped dispatch, expert-choice routing, Pallas-vs-XLA, DCN contract)
+# plus the lm_bench head-to-head grader — expert-choice dropless must beat
+# the capacity path's compiled dot FLOPs by at least the padding fraction
+moe-fast-smoke:
+	$(PY) -m pytest tests/test_moe_dropless.py -q
+	$(PY) tools/lm_bench.py --virtual-cpu --smoke --aot-only --no-sweep \
+		--moe --dropless --router expert_choice \
+		--dp 2 --pp 2 --tp 1 --sp 1 --ep 2 --experts 4 \
+		--wire bf16 --out /tmp/lm_bench_moe_fast_smoke.json
+	$(PY) -c "import json; \
+		d = json.load(open('/tmp/lm_bench_moe_fast_smoke.json')); \
+		assert d['schema'] == 'bluefog-lm-bench-2' and d['ok'], d; \
+		m = d['moe']; \
+		assert m['dispatch'] == 'dropless' and \
+		m['router_mode'] == 'expert_choice', m; \
+		assert d['mfu']['flops_source'] == 'active', d['mfu']; \
+		f = m['dot_flops']; \
+		assert f['ratio'] < 1.0, f; \
+		assert f['delta'] >= f['min_expected_delta'] > 0, f; \
+		r = f['rows_per_device']; \
+		assert r['row_ratio'] <= 1.0 - f['padding_fraction'] + 1e-9, f; \
+		w = d['wire_bytes']; \
+		assert 'all_to_all' in w['ici'], w; \
+		assert set(w['dcn']) == {'collective_permute'}, w; \
+		print('moe-fast-smoke OK')"
 
 # serving smoke: the serve battery (decode oracle, KV slot reuse, bucket
 # zero-retrace, the 8-rank train+serve e2e, the chaos drill) plus the

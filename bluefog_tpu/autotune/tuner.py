@@ -21,6 +21,7 @@ rejection reason recorded in the plan's audit trail.
 """
 from __future__ import annotations
 
+import dataclasses
 import os
 from typing import Optional, Sequence
 
@@ -233,6 +234,11 @@ def tune_carving(
     * ``"step_time"``: analytic pseudo-seconds over both byte classes
       (:func:`cost_model.predicted_carving_step_time_s`).
 
+    For MoE configs the **dispatch scheme is a second scored axis**: every
+    surviving carving is lowered under both the padded capacity path and
+    the dropless grouped path (audit keys gain ``|disp=dropless``; the
+    winner's ``best.config`` carries a ``dispatch`` field), so the plan
+    learns when dropless's worst-case wire blocks beat capacity padding.
     Model-contract violations (``cfg.validate``) and compile failures
     move candidates into the rejection audit rather than raising, so the
     returned plan accounts for every enumerated carving.  Pass
@@ -265,28 +271,56 @@ def tune_carving(
         accepted, rejected = enumerate_carvings(
             n, num_experts=num_experts, require_gossip=require_gossip,
             max_pp=max_pp, max_tp=max_tp, max_sp=max_sp, max_ep=max_ep)
-    considered = len(accepted) + len(rejected)
+    # MoE configs are scored along a second axis: every carving under BOTH
+    # dispatch schemes (padded capacity vs sort-based dropless), so the
+    # plan learns when the grouped path's worst-case buffers beat the
+    # capacity padding.  Dense configs keep the single (mode=None) pass.
+    if num_experts is not None and hasattr(cfg, "dispatch"):
+        modes = ("capacity", "dropless")
+    else:
+        modes = (None,)
+    considered = len(accepted) * len(modes) + len(rejected)
+
+    def mode_cfg(mode):
+        if mode is None:
+            return cfg
+        if mode == "capacity":
+            # expert-choice routing has no capacity variant: the twin is
+            # always the padded top-k scheme
+            return dataclasses.replace(cfg, dispatch="capacity",
+                                       router_mode="topk")
+        return dataclasses.replace(cfg, dispatch=mode)
+
+    def mode_key(cand, mode):
+        return cand.key if mode in (None, "capacity") \
+            else f"{cand.key}|disp={mode}"
 
     scored = []
     for cand in accepted:
-        try:
-            stats = _cm.carving_wire_bytes(cand, cfg, wire=wire,
-                                           remat=remat)
-        except ValueError as e:               # model/carving contract
-            rejected.append({"key": cand.key, "config": cand.config(),
-                             "reason": f"contract: {e}"[:300]})
-            continue
-        except Exception as e:                # noqa: BLE001 — lowering
-            rejected.append({"key": cand.key, "config": cand.config(),
-                             "reason": f"compile failed: "
-                                       f"{type(e).__name__}: {e}"[:300]})
-            continue
-        step_s = _cm.predicted_carving_step_time_s(stats)
-        scored.append({"cand": cand,
-                       "dcn_bytes": int(stats["dcn_bytes"]),
-                       "ici_bytes": int(stats["ici_bytes"]),
-                       "dcn_dtypes": stats["dcn_dtypes"],
-                       "step_time_s": step_s})
+        for mode in modes:
+            key = mode_key(cand, mode)
+            mcfg = mode_cfg(mode)
+            config = cand.config() if mode is None \
+                else {**cand.config(), "dispatch": mode}
+            try:
+                stats = _cm.carving_wire_bytes(cand, mcfg, wire=wire,
+                                               remat=remat)
+            except ValueError as e:           # model/carving contract
+                rejected.append({"key": key, "config": config,
+                                 "reason": f"contract: {e}"[:300]})
+                continue
+            except Exception as e:            # noqa: BLE001 — lowering
+                rejected.append({"key": key, "config": config,
+                                 "reason": f"compile failed: "
+                                           f"{type(e).__name__}: {e}"[:300]})
+                continue
+            step_s = _cm.predicted_carving_step_time_s(stats)
+            scored.append({"cand": cand, "key": key, "config": config,
+                           "dispatch": mode,
+                           "dcn_bytes": int(stats["dcn_bytes"]),
+                           "ici_bytes": int(stats["ici_bytes"]),
+                           "dcn_dtypes": stats["dcn_dtypes"],
+                           "step_time_s": step_s})
     if not scored:
         raise RuntimeError(
             "tune_carving: every carving was rejected or failed to "
@@ -294,8 +328,8 @@ def tune_carving(
 
     def sort_key(e):
         if objective == "dcn_bytes":
-            return (e["dcn_bytes"], e["ici_bytes"], e["cand"].key)
-        return (e["step_time_s"], e["cand"].key)
+            return (e["dcn_bytes"], e["ici_bytes"], e["key"])
+        return (e["step_time_s"], e["key"])
 
     scored.sort(key=sort_key)
     best = scored[0]
@@ -308,9 +342,10 @@ def tune_carving(
         "model": {"n_params": cfg.n_params,
                   "num_experts": num_experts,
                   "capacity_factor": getattr(cfg, "capacity_factor", None),
-                  "top_k": getattr(cfg, "top_k", None)},
+                  "top_k": getattr(cfg, "top_k", None),
+                  "router_mode": getattr(cfg, "router_mode", None)},
         "best": {
-            "config": best["cand"].config(),
+            "config": best["config"],
             "dcn_bytes_per_step_per_chip": best["dcn_bytes"],
             "ici_bytes_per_step_per_chip": best["ici_bytes"],
             "dcn_dtypes": best["dcn_dtypes"],
@@ -319,7 +354,9 @@ def tune_carving(
         "audit": {
             "considered": considered,
             "scored": [
-                {"key": e["cand"].key,
+                {"key": e["key"],
+                 **({"dispatch": e["dispatch"]}
+                    if e["dispatch"] is not None else {}),
                  "dcn_bytes": e["dcn_bytes"],
                  "ici_bytes": e["ici_bytes"],
                  "step_time_s": round(e["step_time_s"], 9)}

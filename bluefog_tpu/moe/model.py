@@ -54,14 +54,16 @@ from jax.sharding import PartitionSpec as P
 
 from ..parallel.compose import AXES, LMConfig, Mesh3D, _ln
 from ..parallel.pipeline import pipeline_apply
-from .layers import moe_ffn_dense, moe_ffn_routed
+from .layers import (moe_ffn_dense, moe_ffn_dense_ec, moe_ffn_dropless,
+                     moe_ffn_expert_choice, moe_ffn_routed)
 
 __all__ = ["MoELMConfig", "init_moe_params", "make_moe_batch",
            "make_moe_grad_fn", "make_moe_probe"]
 
 # carrier-row channel layout (written once per layer, summed over layers):
 # 0 aux (load balance, globalized), 1 router-z, 2 dropped fraction,
-# 3 mean token entropy, 4-5 reserved, 6.. per-expert dispatch fraction
+# 3 mean token entropy, 4 expert-choice coverage (0 under top-k routing),
+# 5 reserved, 6.. per-expert dispatch fraction
 _CH_FIXED = 6
 
 
@@ -81,20 +83,27 @@ class MoELMConfig(LMConfig):
     capacity_factor: float = 1.25
     aux_alpha: float = 1e-2  # load-balance loss weight
     z_alpha: float = 1e-3    # router z-loss weight
+    router_mode: str = "topk"      # "topk" | "expert_choice"
+    dispatch: str = "capacity"     # "capacity" | "dropless"
+    group_tile: int = 8            # dropless grouped-GEMM tile rows
 
     @classmethod
     def from_env(cls, **overrides) -> "MoELMConfig":
         """Defaults from ``BLUEFOG_MOE_*`` env knobs (explicit kwargs
         win): ``BLUEFOG_MOE_EXPERTS``, ``BLUEFOG_MOE_TOPK``,
         ``BLUEFOG_MOE_CAPACITY_FACTOR``, ``BLUEFOG_MOE_AUX_ALPHA``,
-        ``BLUEFOG_MOE_Z_ALPHA``."""
+        ``BLUEFOG_MOE_Z_ALPHA``, ``BLUEFOG_MOE_ROUTER``,
+        ``BLUEFOG_MOE_DISPATCH``, ``BLUEFOG_MOE_TILE``."""
         env = {}
         for key, name, cast in (
                 ("num_experts", "BLUEFOG_MOE_EXPERTS", int),
                 ("top_k", "BLUEFOG_MOE_TOPK", int),
                 ("capacity_factor", "BLUEFOG_MOE_CAPACITY_FACTOR", float),
                 ("aux_alpha", "BLUEFOG_MOE_AUX_ALPHA", float),
-                ("z_alpha", "BLUEFOG_MOE_Z_ALPHA", float)):
+                ("z_alpha", "BLUEFOG_MOE_Z_ALPHA", float),
+                ("router_mode", "BLUEFOG_MOE_ROUTER", str),
+                ("dispatch", "BLUEFOG_MOE_DISPATCH", str),
+                ("group_tile", "BLUEFOG_MOE_TILE", int)):
             raw = os.environ.get(name)
             if raw is not None:
                 try:
@@ -136,6 +145,34 @@ class MoELMConfig(LMConfig):
                 and self.capacity_factor > 0):
             raise ValueError(
                 f"capacity_factor ({self.capacity_factor!r}) must be > 0")
+        if self.dispatch not in ("capacity", "dropless"):
+            raise ValueError(
+                f"dispatch ({self.dispatch!r}) must be 'capacity' or "
+                "'dropless'")
+        if self.router_mode not in ("topk", "expert_choice"):
+            raise ValueError(
+                f"router_mode ({self.router_mode!r}) must be 'topk' or "
+                "'expert_choice'")
+        if not isinstance(self.group_tile, int) or self.group_tile < 1:
+            raise ValueError(
+                f"group_tile ({self.group_tile!r}) must be a positive int")
+        if self.router_mode == "expert_choice":
+            if self.dispatch != "dropless":
+                raise ValueError(
+                    "router_mode='expert_choice' requires "
+                    "dispatch='dropless': expert choice has no capacity "
+                    "overflow to drop, so the padded-slot path does not "
+                    "apply")
+            if m.sp != 1:
+                raise ValueError(
+                    f"router_mode='expert_choice' requires sp=1 (got "
+                    f"sp={m.sp}): experts select their top-C tokens over "
+                    "the whole sequence dimension")
+            if self.ec_capacity(m) > self.seq_len // m.sp:
+                raise ValueError(
+                    f"expert-choice capacity ({self.ec_capacity(m)}) > "
+                    f"local seq_len ({self.seq_len // m.sp}): raise "
+                    "num_experts or shrink top_k")
 
     def capacity(self, m: Mesh3D) -> int:
         """Static per-(source, expert, choice) slot count for one
@@ -144,6 +181,14 @@ class MoELMConfig(LMConfig):
         tokens = (self.batch // m.ep) * (self.seq_len // m.sp)
         return max(1, math.ceil(
             float(self.capacity_factor) * tokens / self.num_experts))
+
+    def ec_capacity(self, m: Mesh3D) -> int:
+        """Expert-choice top-C per (expert, batch row):
+        ``ceil(top_k * seq_len / num_experts)`` — the token budget that
+        matches top-k routing's ACTIVE work exactly, with zero padding
+        (every one of the ``E * C`` slots is a real token)."""
+        return max(1, math.ceil(
+            self.top_k * (self.seq_len // m.sp) / self.num_experts))
 
     @property
     def n_params(self) -> int:
@@ -277,23 +322,45 @@ def _make_forward(cfg: MoELMConfig, m: Mesh3D, *, remat: bool,
                                 pallas_block_q=min(512, cfg.seq_len))
         return x + lax.psum(att.reshape(Bl, Tl, D // TP) @ lp["wo"], "tp")
 
+    ec = cfg.router_mode == "expert_choice"
+    ecC = cfg.ec_capacity(m) if ec else 0
+    dropless = cfg.dispatch == "dropless"
+
     def moe_block(lp, rp, xp, x, positions):
         x = attn_sublayer(lp, x, positions)
-        h = _ln(x).reshape(Bl * Tl, D)
-        if dense_equiv:
+        h3 = _ln(x)                                 # [Bl, Tl, D]
+        h = h3.reshape(Bl * Tl, D)
+        if ec and dense_equiv:
+            y3, st = moe_ffn_dense_ec(h3, rp["wr"], xp["w1"], xp["w2"],
+                                      capacity=ecC, axis="expert")
+        elif ec:
+            y3, st = moe_ffn_expert_choice(
+                h3, rp["wr"], xp["w1"], xp["w2"],
+                num_experts=E, capacity=ecC, axis="expert")
+        elif dense_equiv:
             y, st = moe_ffn_dense(h, rp["wr"], xp["w1"], xp["w2"],
                                   top_k=k, axis="expert")
+            y3 = y.reshape(Bl, Tl, D)
+        elif dropless:
+            y, st = moe_ffn_dropless(h, rp["wr"], xp["w1"], xp["w2"],
+                                     num_experts=E, top_k=k, axis="expert",
+                                     tile=cfg.group_tile)
+            y3 = y.reshape(Bl, Tl, D)
         else:
             y, st = moe_ffn_routed(h, rp["wr"], xp["w1"], xp["w2"],
                                    num_experts=E, top_k=k, capacity=cap,
                                    axis="expert")
+            y3 = y.reshape(Bl, Tl, D)
         vec = jnp.zeros((n_ch,), x.dtype)
         vec = vec.at[0].set(st["aux"]).at[1].set(st["z"])
         vec = vec.at[2].set(lax.stop_gradient(st["dropped"]))
         vec = vec.at[3].set(lax.stop_gradient(st["entropy"]))
+        if "coverage" in st:
+            vec = vec.at[4].set(lax.stop_gradient(
+                st["coverage"].astype(x.dtype)))
         vec = vec.at[_CH_FIXED:].set(lax.stop_gradient(
             st["usage"].astype(x.dtype)))
-        return x + y.reshape(Bl, Tl, D), vec
+        return x + y3, vec
 
     def stage_fn(sp_params, x):                     # x [Bl+1, Tl, D]
         data, row = x[:Bl], x[Bl:]
@@ -403,6 +470,7 @@ def make_moe_probe(cfg: MoELMConfig, m: Mesh3D, *,
             "z_loss": float(row[1] / L),
             "dropped_fraction": float(row[2] / L),
             "token_entropy": float(row[3] / L),
+            "ec_coverage": float(row[4] / L),
             "usage": [float(x) for x in usage],
             "usage_entropy": float(-(u * np.log(u)).sum()),
             "ce": float(row[-1]),

@@ -17,6 +17,13 @@ With ``num_experts = E > axis_size`` each device owns a contiguous block of
 ``E_local * capacity`` slots per source — the layout the composed 5-axis
 carving (``parallel.compose``) and the routed-MoE reference LM
 (``bluefog_tpu.moe``) build on.
+
+:func:`moe_apply_dropless` is the capacity-free alternative: rows are
+sorted by expert id into contiguous groups, the ``all_to_all`` carries
+sorted per-destination blocks plus a tiny per-(source, expert) count
+exchange instead of padded slots, and the expert work runs as a grouped
+GEMM over the ragged boundaries (``bluefog_tpu.moe.dropless``) — no
+capacity hyperparameter and zero dropped tokens, for any routing.
 """
 from __future__ import annotations
 
@@ -28,7 +35,7 @@ import numpy as np
 from jax import lax
 
 __all__ = ["moe_dispatch", "moe_combine", "moe_apply", "moe_apply_topk",
-           "load_balancing_loss"]
+           "moe_apply_dropless", "load_balancing_loss"]
 
 Axis = str
 
@@ -220,6 +227,116 @@ def moe_apply_topk(
                     num_experts=num_experts)             # one round trip
     gates = topk_gate.T[..., None].astype(x.dtype)       # [k, T, 1]
     return jnp.sum(out.reshape(k, T, D) * gates, axis=0)
+
+
+def moe_apply_dropless(
+    x: jax.Array,                # [T, D] this device's (choice-tiled) rows
+    expert_idx: jax.Array,       # [T] int: chosen expert per row
+    grouped_fn: Callable[[Any, jax.Array, jax.Array], jax.Array],
+    expert_params: Any,
+    *,
+    axis: Axis = "expert",
+    num_experts: Optional[int] = None,
+    tile: int = 8,
+) -> jax.Array:
+    """Dropless (MegaBlocks-style) MoE layer: sort -> grouped GEMM ->
+    inverse permutation.  No ``capacity``, no dropped tokens, no padded
+    slots matmul'd like real tokens — every row reaches its expert and
+    comes home, for ANY routing.
+
+    Rows are stable-sorted by expert id (owner blocks are contiguous
+    because device ``d`` owns the id range ``[d*E_local, (d+1)*E_local)``),
+    carried to their owners by ONE tiled ``all_to_all`` of per-destination
+    blocks plus a tiny int32 ``all_to_all`` of per-(source, expert) counts
+    — sorted groups + counts replace the capacity-padded slot buffer —
+    then regrouped on the owner into the tile-padded buffer of
+    :func:`bluefog_tpu.moe.dropless.tile_layout` and fed to
+    ``grouped_fn(params, xt [n_tiles, tile, D], tile_eid [n_tiles])``
+    (shape-preserving; see ``moe.dropless.grouped_ffn`` for the portable
+    XLA / Pallas implementations).  The return path inverts every step,
+    so dispatch∘combine with an identity ``grouped_fn`` is exactly the
+    identity map — the permutation property tests pin this bit-for-bit.
+
+    Static-shape accounting: XLA (jax 0.4.37 has no ragged collectives)
+    forces worst-case sizing — the wire block per (source, destination)
+    pair is the full ``T`` rows, and the grouped buffer holds
+    ``axis_size * T`` rows plus at most ``tile - 1`` pad rows per local
+    expert.  At ``axis_size == 1`` (the production ``ep=1`` fast path)
+    this is exact: ``T`` rows, no capacity padding, strictly fewer GEMM
+    rows than the capacity scheme whenever ``capacity_factor > 1``.  At
+    ``axis_size > 1`` with data-dependent top-k routing the worst case
+    costs more FLOPs than capacity dispatch — expert-choice routing
+    (statically equal groups, zero padding) is the balanced ``ep>1``
+    fast path; this path is the exactness-first fallback that never
+    drops a token.
+    """
+    from ..moe.dropless import dropless_rows, sort_by_expert, tile_layout
+
+    n = lax.axis_size(axis)
+    E = _resolve_num_experts(axis, num_experts)
+    e_local = E // n
+    T, D = x.shape
+    try:                                 # concrete idx: eager range check
+        idx_c = np.asarray(expert_idx)
+    except Exception:
+        idx_c = None
+    if idx_c is not None and idx_c.size and (idx_c.min() < 0
+                                             or idx_c.max() >= E):
+        raise ValueError(
+            "moe_routing_expert_idx_out_of_range: expert_idx must lie in "
+            f"[0, {E}), got min={idx_c.min()} max={idx_c.max()}; dropless "
+            "dispatch would silently mis-route out-of-range rows")
+    safe_idx = jnp.clip(expert_idx, 0, E - 1)
+
+    # -- source: stable sort by expert id; scatter each destination's rows
+    #    to the front of its wire block
+    order, sizes, _rank = sort_by_expert(safe_idx, E)
+    eid_sorted = safe_idx[order]
+    dev = eid_sorted // e_local
+    dev_counts = jnp.sum(sizes.reshape(n, e_local), axis=1)       # [n]
+    dev_start = jnp.cumsum(dev_counts) - dev_counts
+    src_slot = dev * T + (jnp.arange(T) - dev_start[dev])
+    send = jnp.zeros((n * T, D), x.dtype).at[src_slot].set(x[order])
+    recv = lax.all_to_all(send.reshape(n, T, D), axis,
+                          split_axis=0, concat_axis=0, tiled=True)
+    counts = lax.all_to_all(sizes.reshape(n, e_local), axis,
+                            split_axis=0, concat_axis=0,
+                            tiled=True)               # [n_src, e_local]
+
+    # -- destination: regroup received rows (front-packed per source
+    #    block, expert-sorted within) into the tile-padded grouped buffer
+    bounds = jnp.cumsum(counts, axis=1)               # [n_src, e_local]
+    i = jnp.arange(T)
+    le = jax.vmap(
+        lambda b: jnp.searchsorted(b, i, side="right"))(bounds)  # [n, T]
+    valid = le < e_local                              # i < block total
+    le_c = jnp.minimum(le, e_local - 1)
+    lstart = bounds - counts                          # starts within block
+    src_off = jnp.cumsum(counts, axis=0) - counts     # earlier sources' rows
+    grank = (i[None, :] - jnp.take_along_axis(lstart, le_c, axis=1)
+             + jnp.take_along_axis(src_off, le_c, axis=1))
+    gsz = jnp.sum(counts, axis=0)                     # [e_local]
+    pad_start, tile_eid = tile_layout(gsz, tile=tile, max_rows=n * T)
+    n_pad = dropless_rows(n * T, e_local, tile)
+    # invalid (beyond-count, all-zero) rows park on a trash row past the
+    # buffer; its cotangent is cut by the [:n_pad] slice, so AD stays exact
+    slot = jnp.where(valid, pad_start[le_c] + grank, n_pad).reshape(-1)
+    buf = jnp.zeros((n_pad + 1, D), x.dtype).at[slot].set(
+        recv.reshape(n * T, D))
+    xt = buf[:n_pad].reshape(n_pad // tile, tile, D)
+    out = grouped_fn(expert_params, xt, tile_eid)
+    if out.shape != xt.shape:
+        raise ValueError("grouped_fn must preserve [n_tiles, tile, D] "
+                         f"shape, got {out.shape} for {xt.shape}")
+    o_pad = jnp.concatenate(
+        [out.reshape(n_pad, D), jnp.zeros((1, D), out.dtype)], axis=0)
+    back = o_pad[slot].reshape(n, T, D)
+
+    # -- home: invert the wire blocks, then the sort
+    home = lax.all_to_all(back, axis, split_axis=0, concat_axis=0,
+                          tiled=True).reshape(n * T, D)
+    y_sorted = home[src_slot]
+    return jnp.zeros((T, D), home.dtype).at[order].set(y_sorted)
 
 
 def load_balancing_loss(router_probs: jax.Array,

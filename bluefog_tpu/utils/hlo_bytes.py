@@ -224,3 +224,60 @@ def stablehlo_wire_stats(stablehlo_txt: str, slice_size: int):
     out["ici_dtypes"] = sorted(out["ici_dtypes"])
     out["dcn_dtypes"] = sorted(out["dcn_dtypes"])
     return out
+
+
+# ---------------------------------------------------------------------------
+# dot FLOP accounting from PRE-optimization StableHLO.  Pre-opt is again
+# the honest layer: it counts the matmul work the *program* states (the
+# grouped-vs-capacity MoE comparison lm_bench grades), before the CPU
+# backend's algebraic simplifications can hide padding waste.
+# ---------------------------------------------------------------------------
+
+# pretty form: `stablehlo.dot_general %a, %b, [batching_dims = [..] x
+# [..],] contracting_dims = [..] x [..], ... : (tensor<A>, tensor<B>) ->
+# tensor<R>`; generic form carries `#stablehlo.dot<...
+# lhs_contracting_dimensions = [..] ...>` instead.
+_SHLO_DOT_RE = re.compile(r"stablehlo\.dot_general")
+_SHLO_DOT_CONTRACT_RE = re.compile(
+    r"(?:(?<!lhs_)(?<!rhs_)contracting_dims\s*=\s*\[([\d,\s]*)\]\s*x"
+    r"|lhs_contracting_dimensions\s*=\s*\[([\d,\s]*)\])")
+_SHLO_DOT_SIG_RE = re.compile(
+    r":\s*\(tensor<([^>]+)>,\s*tensor<[^>]+>\)\s*->\s*tensor<([^>]+)>")
+
+
+def _shlo_dims(spec: str):
+    """``"5x2x16xf32"`` -> ``[5, 2, 16]`` (scalar ``"f32"`` -> ``[]``)."""
+    return [int(d) for d in spec.split("x") if d.isdigit()]
+
+
+def stablehlo_dot_flops(stablehlo_txt: str) -> int:
+    """Total FLOPs of every ``stablehlo.dot_general`` in the module:
+    ``2 * prod(result dims) * prod(lhs contracting dims)`` per op, the
+    standard multiply-add convention.  Counts static occurrences once
+    (per-chip under SPMD shard_map) — loop trip counts (``lax.scan``
+    bodies lower to a single region) are NOT multiplied in, so compare
+    programs of identical structure, which is exactly the dropless-vs-
+    capacity head-to-head.  Raises on a dot whose contracting dims or
+    type signature cannot be parsed — silent undercounting would make
+    the graded ratio a lie."""
+    total = 0
+    for line in stablehlo_txt.splitlines():
+        if not _SHLO_DOT_RE.search(line):
+            continue
+        cm = _SHLO_DOT_CONTRACT_RE.search(line)
+        sm = _SHLO_DOT_SIG_RE.search(line)
+        if cm is None or sm is None:
+            raise ValueError(
+                "stablehlo_dot_flops: unparseable dot_general line "
+                f"(contracting dims or type signature missing): {line!r}")
+        contract = [int(d) for d in
+                    re.findall(r"\d+", cm.group(1) or cm.group(2))]
+        lhs, res = _shlo_dims(sm.group(1)), _shlo_dims(sm.group(2))
+        k = 1
+        for d in contract:
+            k *= lhs[d]
+        n = 1
+        for d in res:
+            n *= d
+        total += 2 * n * k
+    return int(total)

@@ -393,7 +393,12 @@ def test_tune_carving_picks_low_dcn_expert_carving(cpu_devices):
     scored = {e["key"]: e for e in plan["audit"]["scored"]}
     rejected = {r["key"]: r["reason"] for r in plan["audit"]["rejected"]}
     assert plan["audit"]["considered"] == len(scored) + len(rejected)
-    assert len(scored) == 3
+    # 3 legal carvings x 2 dispatch schemes (capacity + dropless)
+    assert len(scored) == 6
+    assert "carve|dp=2|pp=2|tp=1|sp=1|ep=2|disp=dropless" in scored
+    assert scored["carve|dp=2|pp=2|tp=1|sp=1|ep=2|disp=dropless"][
+        "dispatch"] == "dropless"
+    assert "dispatch" in plan["best"]["config"]
 
     # the two contract violations never reached a compile
     assert rejected["carve|dp=1|pp=2|tp=2|sp=2|ep=1"].startswith(

@@ -754,6 +754,49 @@ def test_win_put_wire_compresses_tpu_payload(tpu_mesh):
     assert not any(re.search(r"f32\[\d{4,}", lines[l]) for l in starts)
 
 
+# Unlike the flash kernels' bool-mask gather (the xfail above), the
+# grouped MoE kernel's scalar-prefetch index maps (weight block chosen by
+# the prefetched tile_eid vector) legalize cleanly through this Mosaic —
+# verified passing, so no xfail guard: a regression here should go red.
+def test_grouped_moe_kernel_lowers_for_tpu(tpu_mesh):
+    """The dropless grouped-GEMM Pallas kernel (ops/pallas_moe.py) fwd+bwd
+    compiles through Mosaic for v5e: the scalar-prefetched ``tile_eid``
+    drives the per-tile expert weight BlockSpec index maps, so expert
+    weights stream from HBM tile-by-tile instead of a gathered
+    ``w[tile_eid]`` copy materializing in full.  Compiled replicated over
+    the AOT mesh — no collectives, same local program one chip runs."""
+    from bluefog_tpu.ops.pallas_moe import grouped_ffn_pallas
+
+    E_, G, tile, D, F = 4, 8, 128, 128, 256
+
+    def loss(xt, w1, w2, eid):
+        out = grouped_ffn_pallas(xt, eid, w1, w2, interpret=False)
+        return jnp.sum(out.astype(jnp.float32) ** 2)
+
+    def per_rank(xt, w1, w2, eid):
+        xt, w1, w2, eid = jax.tree.map(lambda t: t[0], (xt, w1, w2, eid))
+        l, g = jax.value_and_grad(loss, argnums=(0, 1, 2))(xt, w1, w2, eid)
+        return jax.tree.map(lambda t: t[None], (l, g))
+
+    fn = jax.jit(jax.shard_map(
+        per_rank, mesh=tpu_mesh, in_specs=(P("rank"),) * 4,
+        out_specs=P("rank"), check_vma=False))
+    sds = (jax.ShapeDtypeStruct((N, G, tile, D), jnp.float32,
+                                sharding=NamedSharding(tpu_mesh, P("rank"))),
+           jax.ShapeDtypeStruct((N, E_, D, F), jnp.float32,
+                                sharding=NamedSharding(tpu_mesh, P("rank"))),
+           jax.ShapeDtypeStruct((N, E_, F, D), jnp.float32,
+                                sharding=NamedSharding(tpu_mesh, P("rank"))),
+           jax.ShapeDtypeStruct((N, G), jnp.int32,
+                                sharding=NamedSharding(tpu_mesh, P("rank"))))
+    txt = fn.lower(*sds).compile().as_text()
+    # the forward grouped GEMM is a Mosaic program (backward is XLA
+    # scatter-adds by design — see pallas_moe._grouped_bwd)
+    assert txt.count("tpu_custom_call") >= 1
+    # and no dense [G*tile, E*F] gathered-weight intermediate materializes
+    assert f"{G * tile},{E_ * F}" not in txt.replace(" ", "")
+
+
 @_MOSAIC_DYNAMIC_GATHER
 @pytest.mark.parametrize("scan_layers,remat", [
     (False, False),       # stage-0 lm_bench_pallas default (pre-scan era)

@@ -222,6 +222,18 @@ def _battery_steps(tag: str, stage: int = 0) -> list:
                        "--experts", "4", "--out",
                        os.path.join(m, f"lm_bench_moe_{tag}.json")],
                       2400, None, None))
+        # the dropless fast-path row: same 5-axis carve with sort-based
+        # grouped dispatch + expert-choice routing — the artifact's
+        # dot_flops head-to-head (dropless vs capacity-twin compiled dot
+        # FLOPs) and per_step_s_capacity give the measured win on real
+        # hardware, where the grouped GEMM also exercises the Pallas path
+        steps.append(("lm_bench_moe_dropless",
+                      [py, lm, "--moe", "--dropless", "--router",
+                       "expert_choice", "--dp", "2", "--pp", "2",
+                       "--tp", "1", "--sp", "1", "--ep", "2",
+                       "--experts", "4", "--out",
+                       os.path.join(m, f"lm_bench_moe_dropless_{tag}.json")],
+                      2400, None, None))
     sb = os.path.join(REPO, "tools", "serve_bench.py")
     if os.path.exists(sb):
         # the serving grader on the same 8 chips: 2 training replicas
@@ -333,6 +345,13 @@ def _rehearsal_steps(tag: str) -> list:
           "--tp", "1", "--sp", "1", "--ep", "2", "--experts", "4",
           "--out", os.path.join(m, f"lm_bench_moe_{tag}.json")], 900,
          None, None),
+        ("lm_bench_moe_dropless",
+         [py, os.path.join(REPO, "tools", "lm_bench.py"),
+          "--virtual-cpu", "--smoke", "--moe", "--dropless",
+          "--router", "expert_choice", "--dp", "2", "--pp", "2",
+          "--tp", "1", "--sp", "1", "--ep", "2", "--experts", "4",
+          "--out", os.path.join(m, f"lm_bench_moe_dropless_{tag}.json")],
+         900, None, None),
         ("serve_bench",
          [py, os.path.join(REPO, "tools", "serve_bench.py"),
           "--virtual-cpu", "--smoke",

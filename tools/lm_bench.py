@@ -32,6 +32,14 @@ fraction, load-balance aux, per-expert usage entropy — read off the
 forward-only probe OUTSIDE the timed window, so the graded step stays
 the production step.
 
+``--dropless`` (with ``--moe``) swaps the padded capacity dispatch for
+the sort-based grouped dropless path (``--router expert_choice`` for the
+statically balanced expert-choice mode) and grades the two head-to-head
+on the SAME carving: pre-opt StableHLO dot-FLOP totals for both programs
+(``moe.dot_flops`` — ratio, analytic grouped-GEMM rows, the
+capacity-padding fraction the delta must clear) plus the capacity twin's
+per-step time (``moe.per_step_s_capacity``) when the run is live.
+
 Emits a ``bluefog-lm-bench-2`` JSON artifact (last stdout line, and
 ``--out``; schema 2 adds the nullable ``moe`` block).  ``--aot-only``
 skips execution and fills the byte/codec fields only — the CPU AOT
@@ -45,6 +53,7 @@ MoE:    python tools/lm_bench.py --virtual-cpu --smoke --moe --ep 2 \\
             --experts 4
 """
 import argparse
+import dataclasses
 import importlib.util
 import json
 import os
@@ -88,6 +97,17 @@ def main():
     ap.add_argument("--capacity-factor", type=float, default=None,
                     help="expert capacity factor (default "
                          "BLUEFOG_MOE_CAPACITY_FACTOR or 1.25)")
+    ap.add_argument("--dropless", action="store_true",
+                    help="dropless grouped dispatch instead of the padded "
+                         "capacity path (requires --moe); grades the two "
+                         "head-to-head: per-step time + HLO dot-FLOP delta")
+    ap.add_argument("--router", choices=("topk", "expert_choice"),
+                    default=None,
+                    help="routing mode (default BLUEFOG_MOE_ROUTER or "
+                         "topk; expert_choice requires --dropless, sp=1)")
+    ap.add_argument("--group-tile", type=int, default=None,
+                    help="dropless grouped-GEMM tile rows (default "
+                         "BLUEFOG_MOE_TILE or 8)")
     ap.add_argument("--wire", default=None,
                     help="gossip DCN codec (bf16 / fp8 / fp8@64 / int8@...)")
     ap.add_argument("--seq", type=int, default=None,
@@ -129,6 +149,10 @@ def main():
         print("refusing: --ep > 1 needs --moe (the dense LM has no expert "
               "axis)", file=sys.stderr)
         sys.exit(2)
+    if (args.dropless or args.router or args.group_tile) and not args.moe:
+        print("refusing: --dropless/--router/--group-tile need --moe",
+              file=sys.stderr)
+        sys.exit(2)
     n_chips = args.dp * args.pp * args.tp * args.sp * args.ep
     if args.virtual_cpu:
         flags = os.environ.get("XLA_FLAGS", "")
@@ -169,7 +193,8 @@ def main():
     from bluefog_tpu.utils import chaos as bfchaos
     from bluefog_tpu.utils import flight as bfflight
     from bluefog_tpu.utils import metrics as bfm
-    from bluefog_tpu.utils.hlo_bytes import stablehlo_wire_stats
+    from bluefog_tpu.utils.hlo_bytes import (stablehlo_dot_flops,
+                                             stablehlo_wire_stats)
     from bluefog_tpu import diagnostics as bfdiag
 
     bf.init(platform="cpu" if args.virtual_cpu else None)
@@ -187,6 +212,12 @@ def main():
             overrides["top_k"] = args.top_k
         if args.capacity_factor is not None:
             overrides["capacity_factor"] = args.capacity_factor
+        if args.dropless:
+            overrides["dispatch"] = "dropless"
+        if args.router is not None:
+            overrides["router_mode"] = args.router
+        if args.group_tile is not None:
+            overrides["group_tile"] = args.group_tile
         cfg = bfmoe.MoELMConfig.from_env(
             vocab=vocab, d_model=d_model, heads=heads, layers=layers,
             seq_len=seq, micro=micro, batch=batch, **overrides)
@@ -203,11 +234,12 @@ def main():
         **carve_kw)
     cfg.validate(m)
 
-    def build_step(mesh3d):
+    def build_step(mesh3d, c=None):
+        c = cfg if c is None else c
         if args.moe:
-            grad_fn = bfmoe.make_moe_grad_fn(cfg, mesh3d, remat=args.remat)
+            grad_fn = bfmoe.make_moe_grad_fn(c, mesh3d, remat=args.remat)
         else:
-            grad_fn = compose.make_lm_grad_fn(cfg, mesh3d, remat=args.remat,
+            grad_fn = compose.make_lm_grad_fn(c, mesh3d, remat=args.remat,
                                               use_pallas=args.pallas)
         return compose.make_train_step(
             mesh3d, grad_fn, optax.adam(5e-3),
@@ -273,6 +305,9 @@ def main():
         "per_step_s": None,
         "tokens_per_sec": None,
         "mfu": {"flops_per_token": flops_per_token,
+                # MoE configs count ACTIVE-expert flops (top-k, not all E):
+                # MoELMConfig.flops_per_token rides n_active_params
+                "flops_source": "active" if args.moe else "dense",
                 "model_flops_per_sec": None,
                 "peak_flops_per_chip": None, "mfu": None},
         "overlap": None,
@@ -292,12 +327,60 @@ def main():
             "capacity_factor": cfg.capacity_factor,
             "capacity": cfg.capacity(m),
             "n_active_params": cfg.n_active_params,
+            "dispatch": cfg.dispatch,
+            "router_mode": cfg.router_mode,
+            "group_tile": cfg.group_tile,
             # routing health (filled by the probe after the timed run)
             "routing_entropy": None,
             "dropped_fraction": None,
             "aux_loss": None,
             "z_loss": None,
             "usage_entropy": None,
+            "ec_coverage": None,
+            "dot_flops": None,
+            "per_step_s_capacity": None,
+        }
+
+    if args.moe and cfg.dispatch == "dropless":
+        # head-to-head vs the padded capacity path: lower the capacity/topk
+        # twin of the SAME carving and count every stablehlo.dot_general.
+        # Everything outside the MoE sublayer is program-identical, so the
+        # delta is the dispatch scheme's matmul cost.
+        from bluefog_tpu.moe.dropless import dropless_rows
+        cap_cfg = dataclasses.replace(cfg, dispatch="capacity",
+                                      router_mode="topk")
+        cap_step, cap_strategy = build_step(m, cap_cfg)
+        cap_state = bfopt.init_distributed(
+            cap_strategy, jax.tree.map(np.asarray, params))
+        cap_shlo = cap_step.lower(params, cap_state, toks).as_text()
+        drop_flops = stablehlo_dot_flops(shlo)
+        cap_flops = stablehlo_dot_flops(cap_shlo)
+        # analytic grouped-GEMM rows per device per MoE sublayer: the
+        # graded guarantee is row-level (HLO totals add router/attention
+        # dots shared by both programs)
+        e_local = cfg.num_experts // m.ep
+        if cfg.router_mode == "expert_choice":
+            rows_drop = e_local * m.ep * (batch // m.ep) * cfg.ec_capacity(m)
+        else:
+            rows_drop = dropless_rows(
+                m.ep * cfg.top_k * (batch // m.ep) * (seq // m.sp),
+                e_local, cfg.group_tile)
+        rows_cap = cfg.num_experts * cfg.top_k * cfg.capacity(m)
+        f_local = cfg.ffn_mult * d_model // m.tp
+        doc["moe"]["dot_flops"] = {
+            "dropless": drop_flops,
+            "capacity": cap_flops,
+            "delta": cap_flops - drop_flops,
+            "ratio": round(drop_flops / cap_flops, 6),
+            "rows_per_device": {
+                "dropless": rows_drop, "capacity": rows_cap,
+                "row_ratio": round(rows_drop / rows_cap, 6)},
+            "padding_fraction": round(
+                max(0.0, 1.0 - 1.0 / float(cfg.capacity_factor)), 6),
+            # one forward grouped-FFN occurrence at the row delta: the
+            # floor any honest dot-flop delta must clear
+            "min_expected_delta": 4 * d_model * f_local
+                                  * max(0, rows_cap - rows_drop),
         }
 
     if args.aot_only:
@@ -341,6 +424,7 @@ def main():
     doc["mfu"] = {
         "flops_per_token": flops_per_token,
         "model_flops_per_sec": round(tok_per_sec * flops_per_token, 1),
+        "flops_source": "active" if args.moe else "dense",
         "peak_flops_per_chip": peak,
         "mfu": (round(tok_per_sec * flops_per_token / (peak * n_chips), 4)
                 if peak else None),
@@ -386,9 +470,35 @@ def main():
             "aux_loss": round(float(health["aux_loss"]), 4),
             "z_loss": round(float(health["z_loss"]), 4),
             "usage_entropy": round(float(health["usage_entropy"]), 4),
+            "ec_coverage": round(float(health["ec_coverage"]), 4),
         })
         doc["ok"] = bool(doc["ok"]
                          and 0.0 <= doc["moe"]["dropped_fraction"] <= 1.0)
+        if cfg.dispatch == "dropless":
+            # dropless is drop-free BY CONSTRUCTION: a nonzero probe value
+            # here is a dispatch bug, not a tuning problem
+            doc["ok"] = bool(doc["ok"]
+                             and doc["moe"]["dropped_fraction"] == 0.0)
+            # time the capacity/topk twin on the same carving, outside the
+            # graded window (fresh params/state; the graded step and its
+            # donation probe are untouched)
+            cap_cfg = dataclasses.replace(cfg, dispatch="capacity",
+                                          router_mode="topk")
+            cap_step, cap_strategy = build_step(m, cap_cfg)
+            cap_params = compose.device_put(
+                m, bfmoe.init_moe_params(cap_cfg, m))
+            cap_state = bfopt.init_distributed(
+                cap_strategy, jax.tree.map(np.asarray, cap_params))
+            for _ in range(2):                     # compile + warm
+                cap_params, cap_state, _ = cap_step(cap_params, cap_state,
+                                                    toks)
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                cap_params, cap_state, _ = cap_step(cap_params, cap_state,
+                                                    toks)
+            bf.hard_sync(cap_params)
+            doc["moe"]["per_step_s_capacity"] = round(
+                (time.perf_counter() - t0) / (iters * steps_per_call), 6)
 
     if args.chaos:
         stragglers = bfdiag.detect_stragglers()

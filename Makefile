@@ -9,7 +9,7 @@ PY ?= python
 	obs-smoke chaos-smoke overlap-smoke postmortem-smoke pod-smoke \
 	autotune-smoke elastic-smoke lm-smoke moe-smoke moe-fast-smoke \
 	serve-smoke \
-	serve-fast-smoke \
+	serve-fast-smoke flash-decode-smoke \
 	async-smoke regrow-smoke
 
 test:
@@ -227,7 +227,7 @@ serve-smoke:
 		--out /tmp/serve_bench_smoke.json
 	$(PY) -c "import json; \
 		d = json.load(open('/tmp/serve_bench_smoke.json')); \
-		assert d['schema'] == 'bluefog-serve-bench-3' and d['ok'], d; \
+		assert d['schema'] == 'bluefog-serve-bench-4' and d['ok'], d; \
 		i = d['invariants']; \
 		assert i['donation_intact'] and \
 		i['retraces_after_warmup'] == 0, i; \
@@ -250,7 +250,7 @@ serve-fast-smoke:
 		--out /tmp/serve_bench_fast_smoke.json
 	$(PY) -c "import json; \
 		d = json.load(open('/tmp/serve_bench_fast_smoke.json')); \
-		assert d['schema'] == 'bluefog-serve-bench-3' and d['ok'], d; \
+		assert d['schema'] == 'bluefog-serve-bench-4' and d['ok'], d; \
 		s = d['spec']; \
 		assert s['bit_identical'] and s['drafted'] > 0, s; \
 		p = d['prefix']; \
@@ -260,6 +260,30 @@ serve-fast-smoke:
 		assert k['ratio'] <= 0.5, k; \
 		assert d['invariants']['retraces_after_warmup'] == 0, d; \
 		print('serve-fast-smoke OK')"
+
+# flash-decode smoke: the paged Pallas decode-kernel oracle battery
+# (float64 exactness on raw pages, codec drift bounds, block-count
+# invariance, eager contracts) plus serve_bench through the kernel with
+# fused int8 dequant and shared prefix pages — gated on the schema-4
+# decode row: kernel-vs-XLA token bit-identity and a populated
+# decode-MFU-at-context sweep
+flash-decode-smoke:
+	$(PY) -m pytest tests/test_pallas_decode.py -q -m "not slow"
+	$(PY) tools/serve_bench.py --virtual-cpu --smoke \
+		--decode-kernel pallas@8 --kv-dtype int8 --prefix-pages 2x8 \
+		--out /tmp/serve_bench_flash_smoke.json
+	$(PY) -c "import json; \
+		d = json.load(open('/tmp/serve_bench_flash_smoke.json')); \
+		assert d['schema'] == 'bluefog-serve-bench-4' and d['ok'], d; \
+		dec = d['decode']; \
+		assert dec['kernel'] == 'pallas' and dec['block_k'] == 8, dec; \
+		assert dec['bit_identical'], dec; \
+		rows = dec['attend']; \
+		assert rows and all(r['wall_us'] > 0 and r['xla_wall_us'] > 0 \
+		for r in rows), rows; \
+		assert {r['kv_dtype'] for r in rows} == {'raw', 'int8'}, rows; \
+		assert d['invariants']['retraces_after_warmup'] == 0, d; \
+		print('flash-decode-smoke OK')"
 
 # mesh-regrowth smoke: the regrow pytest battery (reinit, carry oracle,
 # chaos abort/rollback, autoscaler) plus the subprocess grow-by-2 drill —
@@ -285,7 +309,7 @@ regrow-smoke:
 		--traffic-trace flash-crowd --out /tmp/serve_bench_trace.json
 	$(PY) -c "import json; \
 		d = json.load(open('/tmp/serve_bench_trace.json')); \
-		assert d['schema'] == 'bluefog-serve-bench-3' and d['ok'], d; \
+		assert d['schema'] == 'bluefog-serve-bench-4' and d['ok'], d; \
 		t = d['trace']; \
 		assert t['ok'] and t['failed'] == 0, t; \
 		assert t['grow_step'] is not None and \

@@ -227,8 +227,13 @@ def _backward_kernel(qoff_ref, koff_ref, q_ref, k_ref, v_ref, do_ref,
         s = _apply_causal_mask(s, qoff_ref, koff_ref, block_q, window)
     safe_lse = jnp.where(jnp.isneginf(lse), 0.0, lse)
     p = jnp.exp(s - safe_lse)
-    # masked scores and rows with no valid keys (padded rows carry lse=-inf)
-    p = jnp.where((s <= NEG_INF / 2) | jnp.isneginf(lse), 0.0, p)
+    # masked scores and rows with no valid keys (padded rows carry lse=-inf).
+    # Broadcast lse to the score shape as f32 BEFORE the -inf test: a bool
+    # [QB, 1] -> [QB, Tk] lane-broadcast lowers to a tpu.dynamic_gather on
+    # vector<8x128xi1> that Mosaic cannot legalize, while f32 lane-broadcasts
+    # (already used by `s - safe_lse` above) compile fine.
+    lse_full = jnp.broadcast_to(lse, s.shape)
+    p = jnp.where((s <= NEG_INF / 2) | jnp.isneginf(lse_full), 0.0, p)
 
     dv = jax.lax.dot_general(
         p, do, (((0,), (0,)), ((), ())),

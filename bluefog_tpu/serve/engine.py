@@ -59,6 +59,7 @@ from jax.sharding import NamedSharding
 from jax.sharding import PartitionSpec as P
 
 from ..models.transformer import apply_rope, apply_rope_grid, apply_rope_rows
+from ..ops import pallas_decode as _pd
 from ..ops.ulysses import dense_attention
 from ..parallel.compose import AXES, LMConfig, Mesh3D, _ln, draft_carve
 from ..utils import flight as _flight
@@ -138,6 +139,12 @@ class ServeConfig:
       and the page granularity prompts are content-hashed at.
     - ``kv_dtype``: KV page storage — ``"raw"`` (engine dtype), or
       ``"int8"`` / ``"fp8"`` via the wire-codec quantizer.
+    - ``decode_kernel``: decode-attention backend — ``"xla"`` (the
+      gather-then-attend reference in serve/kv_cache.py) or ``"pallas"``
+      (ops/pallas_decode.py: flash decode reading KV pages in place
+      through the slot indirection, dequant fused for int8/fp8 stores).
+      ``decode_block_k`` is the KV-page tile (keys per kernel grid step;
+      clamped to ``max_len`` for short caches).
     - ``temperature`` / ``top_p`` / ``seed``: the fused sampler.  0.0
       temperature is exact greedy (the default); speculative decoding
       requires greedy (its accept rule is argmax-prefix agreement).
@@ -149,6 +156,8 @@ class ServeConfig:
     decode_steps_per_call: int = 1
     dtype: Any = jnp.float32
     kv_dtype: str = "raw"
+    decode_kernel: str = "xla"
+    decode_block_k: int = 128
     spec_decode: int = 0
     spec_stages: int = 1
     prefix_pages: int = 0
@@ -179,6 +188,21 @@ class ServeConfig:
             raise ValueError(f"kv_dtype={self.kv_dtype!r}: expected one of "
                              f"{', '.join(_kv.KV_STORES)}")
         _kv.store_dtype(self.kv_dtype)      # fp8 needs dtype support
+        if self.decode_kernel not in ("xla", "pallas"):
+            raise ValueError(
+                f"decode_kernel={self.decode_kernel!r}: expected 'xla' or "
+                "'pallas'")
+        if self.decode_block_k < 1:
+            raise ValueError("decode_block_k must be >= 1")
+        if self.decode_kernel == "pallas":
+            # fail at config time, not inside the first traced decode step
+            bk = _pd._block_k_for(self.max_len, self.decode_block_k)
+            if self.prefix_pages and self.prefix_page_tokens % bk:
+                raise ValueError(
+                    f"prefix_page_tokens ({self.prefix_page_tokens}) must be "
+                    f"a multiple of the flash-decode KV block "
+                    f"({bk}): the kernel routes whole KV blocks through the "
+                    "shared prefix page, so a prefix may not end mid-block")
         if self.spec_decode < 0:
             raise ValueError("spec_decode (draft depth k) must be >= 0")
         if self.spec_stages < 1:
@@ -219,6 +243,7 @@ class ServeConfig:
         - ``BLUEFOG_SPEC_DECODE='<k>'`` or ``'<k>@<stages>'``
         - ``BLUEFOG_KV_DTYPE='raw'|'int8'|'fp8'``
         - ``BLUEFOG_PREFIX_PAGES='<pages>'`` or ``'<pages>x<page_tokens>'``
+        - ``BLUEFOG_DECODE_KERNEL='xla'|'pallas'`` or ``'pallas@<block_k>'``
         """
         spec = os.environ.get("BLUEFOG_SERVE_BUCKETS", "")
         if spec:
@@ -243,6 +268,20 @@ class ServeConfig:
                     f"BLUEFOG_KV_DTYPE={kd!r}: bad token {kd!r} — expected "
                     f"one of {', '.join(_kv.KV_STORES)}")
             overrides.setdefault("kv_dtype", kd)
+        dk = os.environ.get("BLUEFOG_DECODE_KERNEL", "")
+        if dk:
+            grammar = ("'xla' or 'pallas' or 'pallas@<block_k>' "
+                       "(e.g. 'pallas@128')")
+            kern, _, bk_s = dk.partition("@")
+            if kern not in ("xla", "pallas"):
+                raise ValueError(
+                    f"BLUEFOG_DECODE_KERNEL={dk!r}: bad token {kern!r} — "
+                    f"expected {grammar}")
+            overrides.setdefault("decode_kernel", kern)
+            if bk_s:
+                overrides.setdefault(
+                    "decode_block_k",
+                    _env_int("BLUEFOG_DECODE_KERNEL", bk_s, grammar))
         pp = os.environ.get("BLUEFOG_PREFIX_PAGES", "")
         if pp:
             grammar = ("'<pages>' or '<pages>x<page_tokens>' "
@@ -315,7 +354,7 @@ class ServeEngine:
         # P(AXES) spec normalizes differently (size-1 axes dropped) and
         # would retrace every bucket once on its second visit
         cc = self.cache_cfg
-        per_dev = (1, cc.layers, cc.rows, cc.max_len, cc.kv_heads,
+        per_dev = (1, cc.layers, cc.rows, cc.kv_heads, cc.max_len,
                    cc.head_dim)
         pay_dt = _kv.store_dtype(cc.store, cc.dtype)
 
@@ -396,10 +435,17 @@ class ServeEngine:
         v = v.reshape(S, Hl, hsz)
         cl = _kv.layer_append(cl, slot_ids, lens, k, v,
                               store=self.scfg.kv_dtype)
-        att = _kv.attend_rows(q, cl["k"], cl["v"], slot_ids, lens,
-                              k_scale=cl.get("k_scale"),
-                              v_scale=cl.get("v_scale"),
-                              prefix_slots=prows, prefix_lens=plens)
+        if self.scfg.decode_kernel == "pallas":
+            att = _pd.flash_attend_rows(
+                q, cl["k"], cl["v"], slot_ids, lens,
+                k_scale=cl.get("k_scale"), v_scale=cl.get("v_scale"),
+                prefix_slots=prows, prefix_lens=plens,
+                block_k=self.scfg.decode_block_k)
+        else:
+            att = _kv.attend_rows(q, cl["k"], cl["v"], slot_ids, lens,
+                                  k_scale=cl.get("k_scale"),
+                                  v_scale=cl.get("v_scale"),
+                                  prefix_slots=prows, prefix_lens=plens)
         x = x + lax.psum(att.reshape(S, Hl * hsz) @ lp["wo"], "tp")
         h = _ln(x)
         x = x + lax.psum(jax.nn.gelu(h @ lp["w1"]) @ lp["w2"], "tp")
@@ -519,9 +565,15 @@ class ServeEngine:
                 v = v.reshape(S, T, Hl, hsz)
                 cl = _kv.layer_append_chunk(cl, slot_ids, lens, k, v,
                                             store=self.scfg.kv_dtype)
-                att = _kv.attend_chunk(q, cl, slot_ids, lens,
-                                       prefix_slots=prows,
-                                       prefix_lens=plens)
+                if self.scfg.decode_kernel == "pallas":
+                    att = _pd.flash_attend_chunk(
+                        q, cl, slot_ids, lens,
+                        prefix_slots=prows, prefix_lens=plens,
+                        block_k=self.scfg.decode_block_k)
+                else:
+                    att = _kv.attend_chunk(q, cl, slot_ids, lens,
+                                           prefix_slots=prows,
+                                           prefix_lens=plens)
                 x = x + lax.psum(
                     att.reshape(S, T, Hl * hsz) @ lp["wo"], "tp")
                 h = _ln(x)

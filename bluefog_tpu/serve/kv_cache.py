@@ -3,7 +3,7 @@
 Layout (per device, i.e. per (replica, stage, tp) coordinate of the
 compose carving)::
 
-    k, v: [layers, slots + prefix_slots + 1, max_len, kv_heads, head_dim]
+    k, v: [layers, slots + prefix_slots + 1, kv_heads, max_len, head_dim]
 
 * ``layers``   — the decoder blocks THIS pipeline stage owns;
 * ``slots``    — request slots: one resident sequence each, allocated at
@@ -24,6 +24,12 @@ compose carving)::
   same ``num_kv_heads`` contract as
   :class:`bluefog_tpu.models.transformer.RingTransformerBlock` — q heads
   attend their ``h // group`` kv head).
+
+The layout is **kv-head major** (``kv_heads`` BEFORE ``max_len``): one
+(row, head)'s key positions are contiguous, so the flash-decode kernel
+(:mod:`bluefog_tpu.ops.pallas_decode`) streams ``[block_k, head_dim]``
+K/V blocks straight from HBM as natively-tiled VMEM tiles — no Mosaic
+relayout, no strided DMA.  The XLA paths below index the same layout.
 
 **Quantized storage** (``store="int8"`` / ``"fp8"``): pages hold the
 quantized payload plus per-(position, head) f32 amax scales in sibling
@@ -176,7 +182,7 @@ class KVCacheConfig:
 def init_cache(cfg: KVCacheConfig) -> dict:
     """Zeroed cache dict: ``{"k", "v"}`` payload pages (plus
     ``{"k_scale", "v_scale"}`` when quantized)."""
-    shape = (cfg.layers, cfg.rows, cfg.max_len, cfg.kv_heads, cfg.head_dim)
+    shape = (cfg.layers, cfg.rows, cfg.kv_heads, cfg.max_len, cfg.head_dim)
     dt = store_dtype(cfg.store, cfg.dtype)
     cache = {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
     if cfg.quantized:
@@ -193,14 +199,14 @@ def append_rows(kl: jax.Array, vl: jax.Array, slots: jax.Array,
                 lengths: jax.Array, k_new: jax.Array, v_new: jax.Array):
     """Scatter one new token's raw kv into per-request slots.
 
-    ``kl/vl``: one layer's pages ``[rows, max_len, kv_heads, head_dim]``;
-    ``slots``/``lengths``: ``[S]`` int32 (the new token lands at index
+    ``kl/vl``: one layer's pages ``[rows, kv_heads, max_len, head_dim]``;
+    ``slots``/``lengths``: ``[S]`` int32 (the new token lands at position
     ``lengths[i]`` of ``slots[i]``); ``k_new/v_new``: ``[S, kv_heads,
     head_dim]``.  Duplicate (trash-slot) indices are allowed — last write
     wins, and nothing ever reads the trash row.
     """
-    kl = kl.at[slots, lengths].set(k_new.astype(kl.dtype))
-    vl = vl.at[slots, lengths].set(v_new.astype(vl.dtype))
+    kl = kl.at[slots, :, lengths].set(k_new.astype(kl.dtype))
+    vl = vl.at[slots, :, lengths].set(v_new.astype(vl.dtype))
     return kl, vl
 
 
@@ -215,8 +221,8 @@ def layer_append(cl: Dict[str, jax.Array], slots: jax.Array,
     out["k"], out["v"] = append_rows(cl["k"], cl["v"], slots, lengths,
                                      qk, qv)
     if sk is not None:
-        out["k_scale"] = cl["k_scale"].at[slots, lengths].set(sk)
-        out["v_scale"] = cl["v_scale"].at[slots, lengths].set(sv)
+        out["k_scale"] = cl["k_scale"].at[slots, :, lengths].set(sk)
+        out["v_scale"] = cl["v_scale"].at[slots, :, lengths].set(sv)
     return out
 
 
@@ -234,11 +240,11 @@ def layer_append_chunk(cl: Dict[str, jax.Array], slots: jax.Array,
     qk, sk = quantize_rows(k_new, store)
     qv, sv = quantize_rows(v_new, store)
     out = dict(cl)
-    out["k"] = cl["k"].at[rows, pos].set(qk.astype(cl["k"].dtype))
-    out["v"] = cl["v"].at[rows, pos].set(qv.astype(cl["v"].dtype))
+    out["k"] = cl["k"].at[rows, :, pos].set(qk.astype(cl["k"].dtype))
+    out["v"] = cl["v"].at[rows, :, pos].set(qv.astype(cl["v"].dtype))
     if sk is not None:
-        out["k_scale"] = cl["k_scale"].at[rows, pos].set(sk)
-        out["v_scale"] = cl["v_scale"].at[rows, pos].set(sv)
+        out["k_scale"] = cl["k_scale"].at[rows, :, pos].set(sk)
+        out["v_scale"] = cl["v_scale"].at[rows, :, pos].set(sv)
     return out
 
 
@@ -246,22 +252,24 @@ def layer_prefill(cl: Dict[str, jax.Array], slot_id: jax.Array,
                   k: jax.Array, v: jax.Array,
                   store: str = "raw") -> Dict[str, jax.Array]:
     """Land a whole padded prompt's kv (``[Tpad, kv_heads, head_dim]``)
-    at rows ``0..Tpad-1`` of ``slot_id`` — the prefill write.  Positions
-    past the true length hold garbage that the length masks never read
-    before an append overwrites them."""
+    at positions ``0..Tpad-1`` of ``slot_id`` — the prefill write.
+    Positions past the true length hold garbage that the length masks
+    never read before an append overwrites them."""
     from jax import lax
     qk, sk = quantize_rows(k, store)
     qv, sv = quantize_rows(v, store)
     out = dict(cl)
     out["k"] = lax.dynamic_update_slice(
-        cl["k"], qk[None].astype(cl["k"].dtype), (slot_id, 0, 0, 0))
+        cl["k"], qk.transpose(1, 0, 2)[None].astype(cl["k"].dtype),
+        (slot_id, 0, 0, 0))
     out["v"] = lax.dynamic_update_slice(
-        cl["v"], qv[None].astype(cl["v"].dtype), (slot_id, 0, 0, 0))
+        cl["v"], qv.transpose(1, 0, 2)[None].astype(cl["v"].dtype),
+        (slot_id, 0, 0, 0))
     if sk is not None:
         out["k_scale"] = lax.dynamic_update_slice(
-            cl["k_scale"], sk[None], (slot_id, 0, 0))
+            cl["k_scale"], sk.T[None], (slot_id, 0, 0))
         out["v_scale"] = lax.dynamic_update_slice(
-            cl["v_scale"], sv[None], (slot_id, 0, 0))
+            cl["v_scale"], sv.T[None], (slot_id, 0, 0))
     return out
 
 
@@ -271,22 +279,22 @@ def _gather_pages(cl: Dict[str, jax.Array], slots: jax.Array,
     """Gather each lane's kv rows, reading **through the page
     indirection**: key positions ``< prefix_lens[i]`` come from the
     lane's shared prefix page, the rest from its private slot.  Returns
-    f32-dequantized ``(ks, vs)`` of shape ``[S, max_len, Hkv, Dh]``."""
+    f32-dequantized ``(ks, vs)`` of shape ``[S, Hkv, max_len, Dh]``."""
     ks, vs = cl["k"][slots], cl["v"][slots]
     ksc = cl["k_scale"][slots] if "k_scale" in cl else None
     vsc = cl["v_scale"][slots] if "v_scale" in cl else None
     if prefix_slots is not None:
-        L = cl["k"].shape[1]
+        L = cl["k"].shape[2]
         shared = (jnp.arange(L)[None, :]
                   < prefix_lens[:, None])                       # [S, L]
-        sel = shared[..., None, None]
+        sel = shared[:, None, :, None]
         ks = jnp.where(sel, cl["k"][prefix_slots], ks)
         vs = jnp.where(sel, cl["v"][prefix_slots], vs)
         if ksc is not None:
-            ksc = jnp.where(shared[..., None], cl["k_scale"][prefix_slots],
-                            ksc)
-            vsc = jnp.where(shared[..., None], cl["v_scale"][prefix_slots],
-                            vsc)
+            ksc = jnp.where(shared[:, None, :],
+                            cl["k_scale"][prefix_slots], ksc)
+            vsc = jnp.where(shared[:, None, :],
+                            cl["v_scale"][prefix_slots], vsc)
     ct = jnp.float32
     return dequantize_rows(ks, ksc, ct), dequantize_rows(vs, vsc, ct)
 
@@ -301,7 +309,9 @@ def attend_rows(q: jax.Array, kl: jax.Array, vl: jax.Array,
     """Masked decode attention of one new token per request over its slot.
 
     ``q``: ``[S, heads, head_dim]`` (heads may be ``group * kv_heads`` —
-    grouped-query attention repeats each compact kv head over its group);
+    grouped-query attention: q head ``h`` attends compact kv head
+    ``h // group``, via a reshape-grouped einsum that never materializes
+    repeated K/V copies);
     ``kl/vl``: one layer's pages (post-append); ``lengths``: the position
     the new token was appended at, so keys ``0 .. lengths[i]`` inclusive
     are valid.  ``k_scale/v_scale`` dequantize int8/fp8 pages on the fly;
@@ -311,7 +321,7 @@ def attend_rows(q: jax.Array, kl: jax.Array, vl: jax.Array,
     masking.
     """
     S, H, Dh = q.shape
-    Hkv = kl.shape[-2]
+    Hkv = kl.shape[1]
     if H % Hkv:
         raise ValueError(f"{H} q heads not a multiple of {Hkv} kv heads")
     if scale is None:
@@ -320,15 +330,14 @@ def attend_rows(q: jax.Array, kl: jax.Array, vl: jax.Array,
     if k_scale is not None:
         cl["k_scale"], cl["v_scale"] = k_scale, v_scale
     ks, vs = _gather_pages(cl, slots, prefix_slots, prefix_lens)
-    if Hkv != H:
-        ks = jnp.repeat(ks, H // Hkv, axis=2)
-        vs = jnp.repeat(vs, H // Hkv, axis=2)
     ct = jnp.promote_types(q.dtype, jnp.float32)
-    s = jnp.einsum("shd,slhd->shl", q.astype(ct) * scale, ks.astype(ct))
-    valid = jnp.arange(kl.shape[1])[None, :] <= lengths[:, None]   # [S, L]
-    s = jnp.where(valid[:, None, :], s, -jnp.inf)
+    qg = (q.astype(ct) * scale).reshape(S, Hkv, H // Hkv, Dh)
+    s = jnp.einsum("skgd,skld->skgl", qg, ks.astype(ct))
+    valid = jnp.arange(kl.shape[2])[None, :] <= lengths[:, None]   # [S, L]
+    s = jnp.where(valid[:, None, None, :], s, -jnp.inf)
     p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("shl,slhd->shd", p, vs.astype(ct)).astype(q.dtype)
+    out = jnp.einsum("skgl,skld->skgd", p, vs.astype(ct))
+    return out.reshape(S, H, Dh).astype(q.dtype)
 
 
 def attend_chunk(q: jax.Array, cl: Dict[str, jax.Array], slots: jax.Array,
@@ -342,23 +351,22 @@ def attend_chunk(q: jax.Array, cl: Dict[str, jax.Array], slots: jax.Array,
     (post :func:`layer_append_chunk`) — prefix pages and quantized
     storage read exactly as in :func:`attend_rows`."""
     S, T, H, Dh = q.shape
-    Hkv = cl["k"].shape[-2]
+    Hkv = cl["k"].shape[1]
     if H % Hkv:
         raise ValueError(f"{H} q heads not a multiple of {Hkv} kv heads")
     if scale is None:
         scale = Dh ** -0.5
     ks, vs = _gather_pages(cl, slots, prefix_slots, prefix_lens)
-    if Hkv != H:
-        ks = jnp.repeat(ks, H // Hkv, axis=2)
-        vs = jnp.repeat(vs, H // Hkv, axis=2)
-    L = cl["k"].shape[1]
+    L = cl["k"].shape[2]
     ct = jnp.promote_types(q.dtype, jnp.float32)
-    s = jnp.einsum("sthd,slhd->sthl", q.astype(ct) * scale, ks.astype(ct))
+    qg = (q.astype(ct) * scale).reshape(S, T, Hkv, H // Hkv, Dh)
+    s = jnp.einsum("stkgd,skld->stkgl", qg, ks.astype(ct))
     qpos = lengths[:, None] + jnp.arange(T)[None, :]            # [S, T]
     valid = jnp.arange(L)[None, None, :] <= qpos[:, :, None]    # [S, T, L]
-    s = jnp.where(valid[:, :, None, :], s, -jnp.inf)
+    s = jnp.where(valid[:, :, None, None, :], s, -jnp.inf)
     p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("sthl,slhd->sthd", p, vs.astype(ct)).astype(q.dtype)
+    out = jnp.einsum("stkgl,skld->stkgd", p, vs.astype(ct))
+    return out.reshape(S, T, H, Dh).astype(q.dtype)
 
 
 # ---------------------------------------------------------------------------

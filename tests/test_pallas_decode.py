@@ -1,0 +1,190 @@
+"""ops/pallas_decode: the paged flash-decode kernel vs the XLA oracle.
+
+What is pinned here:
+
+* **the float64 oracle** — interpret-mode ``flash_attend_rows`` /
+  ``flash_attend_chunk`` against ``kv_cache.attend_rows`` /
+  ``attend_chunk`` (the gather-then-attend XLA decode path the engine
+  shipped with) at float64, across every axis the serving hot path
+  exercises: raw / int8 / fp8 KV stores (dequant fused in-kernel), GQA
+  vs MHA, prefix-hit vs cold lanes, ragged lengths including a
+  zero-length lane.  Raw pages must match to f64 epsilon — the kernel's
+  f32 page floor mirrors the oracle's ``_gather_pages`` cast exactly;
+  quantized pages must match the XLA path on the SAME pages to f32
+  epsilon and stay inside the wire-codec drift bounds vs the
+  unquantized oracle;
+* **contract validation** — malformed page ranks, head mismatches,
+  orphan scale args, and non-tiling block sizes are rejected eagerly
+  with named offenders, not inside a traced kernel;
+* **block-count invariance** — multi-block online softmax equals the
+  single-block (whole-cache) kernel, so the early-skip grid carries no
+  numeric cost.
+
+The v5e Mosaic lowering proof for this kernel lives in
+tests/test_tpu_aot.py::test_flash_decode_kernel_lowers_for_tpu.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bluefog_tpu.ops import pallas_decode as pd
+from bluefog_tpu.serve import kv_cache as kv
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+_ORACLE_SCRIPT = """
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["JAX_ENABLE_X64"] = "1"
+import json
+import jax.numpy as jnp
+import numpy as np
+from bluefog_tpu.ops import pallas_decode as pd
+from bluefog_tpu.serve import kv_cache as kv
+
+ROWS, L, Dh, H, S, T, BK = 6, 32, 8, 4, 4, 3, 8
+rng = np.random.default_rng(0)
+
+# ragged lanes: one zero-length lane (attends exactly its key 0), one
+# whole-cache lane; prefix lengths are block-aligned by contract and the
+# zero entries leave those lanes cold (reading only their own slot)
+SLOTS = jnp.asarray([5, 0, 2, 3], jnp.int32)
+LENS = jnp.asarray([8, 0, 20, 27], jnp.int32)
+PSLOTS = jnp.asarray([1, 1, 1, 1], jnp.int32)
+PLENS = jnp.asarray([8, 0, 16, 8], jnp.int32)
+
+
+def stores(Hkv):
+    k = rng.normal(size=(ROWS, Hkv, L, Dh))
+    v = rng.normal(size=(ROWS, Hkv, L, Dh))
+    raw = {"k": jnp.asarray(k), "v": jnp.asarray(v)}
+    out = {"raw": raw}
+    for store in ("int8", "fp8"):
+        qk, sk = kv.quantize_rows(raw["k"], store)
+        qv, sv = kv.quantize_rows(raw["v"], store)
+        out[store] = {"k": qk, "v": qv, "k_scale": sk, "v_scale": sv}
+    return out
+
+
+def run(cl, q, prefix, mode):
+    ps, pl = (PSLOTS, PLENS) if prefix else (None, None)
+    if mode == "rows":
+        flash = pd.flash_attend_rows(
+            q, cl["k"], cl["v"], SLOTS, LENS,
+            k_scale=cl.get("k_scale"), v_scale=cl.get("v_scale"),
+            prefix_slots=ps, prefix_lens=pl, block_k=BK, interpret=True)
+        ref = kv.attend_rows(
+            q, cl["k"], cl["v"], SLOTS, LENS,
+            k_scale=cl.get("k_scale"), v_scale=cl.get("v_scale"),
+            prefix_slots=ps, prefix_lens=pl)
+    else:
+        flash = pd.flash_attend_chunk(
+            q, cl, SLOTS, LENS, prefix_slots=ps, prefix_lens=pl,
+            block_k=BK, interpret=True)
+        ref = kv.attend_chunk(q, cl, SLOTS, LENS,
+                              prefix_slots=ps, prefix_lens=pl)
+    return np.asarray(flash, np.float64), np.asarray(ref, np.float64)
+
+
+doc = {}
+for Hkv in (2, H):                                 # GQA and MHA
+    cls = stores(Hkv)
+    for prefix in (False, True):
+        for mode in ("rows", "chunk"):
+            shape = (S, H, Dh) if mode == "rows" else (S, T, H, Dh)
+            q = jnp.asarray(rng.normal(size=shape))
+            raws = {}
+            for store in ("raw", "int8", "fp8"):
+                flash, ref = run(cls[store], q, prefix, mode)
+                raws[store] = flash
+                key = f"{store}/{'gqa' if Hkv < H else 'mha'}/" \
+                      f"{'hit' if prefix else 'cold'}/{mode}"
+                doc[key] = float(np.abs(flash - ref).max())
+                if store != "raw":                 # wire-codec drift bound
+                    doc[key + "/drift"] = float(
+                        np.abs(flash - raws["raw"]).max())
+print(json.dumps(doc))
+"""
+
+
+def test_float64_oracle_battery():
+    """One x64 subprocess sweeps store x GQA x prefix x call-shape; raw
+    pages are f64-exact against the XLA oracle, quantized pages match the
+    XLA path on the same pages to f32 epsilon and honour the codec drift
+    bounds vs the unquantized oracle."""
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith("BLUEFOG_")
+           and k not in ("XLA_FLAGS", "JAX_PLATFORMS", "JAX_ENABLE_X64")}
+    p = subprocess.run([sys.executable, "-c", _ORACLE_SCRIPT],
+                       cwd=REPO, capture_output=True, text=True,
+                       timeout=420, env=env)
+    assert p.returncode == 0, p.stderr[-3000:]
+    doc = json.loads(p.stdout.strip().splitlines()[-1])
+    assert len(doc) == 40                         # 24 cases + 16 drifts
+    for key, diff in doc.items():
+        if key.endswith("/drift"):
+            bound = 5e-2 if key.startswith("int8") else 1e-1
+        elif key.startswith("raw"):
+            bound = 1e-12                         # f64-exact
+        else:
+            bound = 1e-5                          # same pages, f32 floor
+        assert diff < bound, (key, diff, doc)
+
+
+def _pages(Hkv=2, rows=5, L=32, Dh=8, dtype=jnp.float32, seed=1):
+    rng = np.random.default_rng(seed)
+    kl = jnp.asarray(rng.normal(size=(rows, Hkv, L, Dh)), dtype)
+    vl = jnp.asarray(rng.normal(size=(rows, Hkv, L, Dh)), dtype)
+    return kl, vl
+
+
+def test_block_count_invariance_and_dtype():
+    """Multi-block online softmax == the single-block kernel, and the
+    output dtype follows q (the engine hands bf16 activations in)."""
+    kl, vl = _pages()
+    rng = np.random.default_rng(2)
+    slots = jnp.asarray([0, 3, 4], jnp.int32)
+    lens = jnp.asarray([2, 17, 31], jnp.int32)
+    q = jnp.asarray(rng.normal(size=(3, 4, 8)), jnp.float32)
+    blocked = pd.flash_attend_rows(q, kl, vl, slots, lens, block_k=8,
+                                   interpret=True)
+    whole = pd.flash_attend_rows(q, kl, vl, slots, lens, block_k=32,
+                                 interpret=True)
+    np.testing.assert_allclose(np.asarray(blocked), np.asarray(whole),
+                               atol=1e-6, rtol=1e-6)
+    qb = q.astype(jnp.bfloat16)
+    out = pd.flash_attend_rows(qb, kl, vl, slots, lens, block_k=8,
+                               interpret=True)
+    assert out.dtype == jnp.bfloat16 and out.shape == q.shape
+
+
+def test_contract_validation():
+    kl, vl = _pages()
+    slots = jnp.asarray([0, 1, 2], jnp.int32)
+    lens = jnp.asarray([1, 2, 3], jnp.int32)
+    q = jnp.zeros((3, 4, 8), jnp.float32)
+    with pytest.raises(ValueError, match="does not tile"):
+        pd.flash_attend_rows(q, kl, vl, slots, lens, block_k=24,
+                             interpret=True)
+    with pytest.raises(ValueError, match="sublane"):
+        pd.flash_attend_rows(q, kl, vl, slots, lens, block_k=4,
+                             interpret=True)
+    with pytest.raises(ValueError, match="kv heads"):
+        pd.flash_attend_rows(jnp.zeros((3, 3, 8)), kl, vl, slots, lens,
+                             interpret=True)
+    with pytest.raises(ValueError, match="head_dim"):
+        pd.flash_attend_rows(jnp.zeros((3, 4, 16)), kl, vl, slots, lens,
+                             interpret=True)
+    with pytest.raises(ValueError, match="come together"):
+        pd.flash_attend_rows(q, kl, vl, slots, lens,
+                             k_scale=jnp.zeros((5, 2, 32)), interpret=True)
+    with pytest.raises(ValueError, match="come together"):
+        pd.flash_attend_chunk(
+            jnp.zeros((3, 2, 4, 8)), {"k": kl, "v": vl,
+                                      "v_scale": jnp.zeros((5, 2, 32))},
+            slots, lens, interpret=True)

@@ -125,16 +125,19 @@ def test_attend_rows_matches_dense_gqa():
     reference over the valid prefix, garbage rows masked out."""
     rng = np.random.default_rng(0)
     S, L, H, Hkv, Dh = 3, 8, 4, 2, 6
-    kl = rng.normal(size=(5, L, Hkv, Dh)).astype(np.float32)
-    vl = rng.normal(size=(5, L, Hkv, Dh)).astype(np.float32)
+    kl = rng.normal(size=(5, Hkv, L, Dh)).astype(np.float32)
+    vl = rng.normal(size=(5, Hkv, L, Dh)).astype(np.float32)
     q = rng.normal(size=(S, H, Dh)).astype(np.float32)
     slots = np.array([4, 0, 2], np.int32)
     lens = np.array([3, 7, 1], np.int32)        # attend over rows 0..lens
     out = np.asarray(attend_rows(q, kl, vl, slots, lens))
     for i in range(S):
         n = lens[i] + 1
-        k = np.repeat(kl[slots[i], :n], H // Hkv, axis=1)   # [n, H, Dh]
-        v = np.repeat(vl[slots[i], :n], H // Hkv, axis=1)
+        # [n, Hkv, Dh] -> repeat to [n, H, Dh] for the dense reference
+        k = np.repeat(kl[slots[i], :, :n].transpose(1, 0, 2),
+                      H // Hkv, axis=1)
+        v = np.repeat(vl[slots[i], :, :n].transpose(1, 0, 2),
+                      H // Hkv, axis=1)
         s = np.einsum("hd,lhd->hl", q[i] * Dh ** -0.5, k)
         p = np.exp(s - s.max(-1, keepdims=True))
         p /= p.sum(-1, keepdims=True)
@@ -145,7 +148,7 @@ def test_attend_rows_matches_dense_gqa():
 def test_kv_cache_shapes():
     cfg = KVCacheConfig(layers=2, slots=4, max_len=8, kv_heads=2, head_dim=4)
     c = init_cache(cfg)
-    assert c["k"].shape == (2, 5, 8, 2, 4)      # slots + 1 trash row
+    assert c["k"].shape == (2, 5, 2, 8, 4)      # slots + 1 trash row
     assert cfg.trash_slot == 4
     assert cfg.bytes() == 2 * 2 * 5 * 8 * 2 * 4 * 4
 

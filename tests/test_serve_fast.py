@@ -154,8 +154,8 @@ S, L, H, D = 3, 24, 4, 16
 slots = jnp.arange(S, dtype=jnp.int32)
 lengths = jnp.asarray([7, 15, 23], jnp.int32)
 q = jnp.asarray(rng.normal(size=(S, H, D)))
-k = jnp.asarray(rng.normal(size=(S, L, H, D)))
-v = jnp.asarray(rng.normal(size=(S, L, H, D)))
+k = jnp.asarray(rng.normal(size=(S, H, L, D)))
+v = jnp.asarray(rng.normal(size=(S, H, L, D)))
 ref = attend_rows(q, k, v, slots, lengths)          # float64 raw oracle
 
 
@@ -268,6 +268,8 @@ def test_parse_buckets_names_offending_token():
     ("BLUEFOG_KV_DTYPE", "int4", "'int4'"),
     ("BLUEFOG_PREFIX_PAGES", "q", "'q'"),
     ("BLUEFOG_PREFIX_PAGES", "2xz", "'z'"),
+    ("BLUEFOG_DECODE_KERNEL", "mosaic", "'mosaic'"),
+    ("BLUEFOG_DECODE_KERNEL", "pallas@w", "'w'"),
 ])
 def test_from_env_rejects_bad_specs(monkeypatch, var, val, tok):
     monkeypatch.setenv(var, val)
@@ -281,12 +283,15 @@ def test_from_env_fast_paths(monkeypatch):
     monkeypatch.setenv("BLUEFOG_SPEC_DECODE", "3@1")
     monkeypatch.setenv("BLUEFOG_KV_DTYPE", "int8")
     monkeypatch.setenv("BLUEFOG_PREFIX_PAGES", "2x8")
+    monkeypatch.setenv("BLUEFOG_DECODE_KERNEL", "pallas@8")
     cfg = ServeConfig.from_env()
     assert cfg.spec_decode == 3 and cfg.spec_stages == 1
     assert cfg.kv_dtype == "int8"
     assert cfg.prefix_pages == 2 and cfg.prefix_page_tokens == 8
+    assert cfg.decode_kernel == "pallas" and cfg.decode_block_k == 8
     # explicit overrides beat the env
     assert ServeConfig.from_env(spec_decode=0).spec_decode == 0
+    assert ServeConfig.from_env(decode_kernel="xla").decode_kernel == "xla"
 
 
 def test_serve_config_fast_validation():
@@ -301,6 +306,18 @@ def test_serve_config_fast_validation():
         ServeConfig(top_p=0.0)
     with pytest.raises(ValueError, match="temperature"):
         ServeConfig(temperature=-0.1)
+    with pytest.raises(ValueError, match="decode_kernel"):
+        ServeConfig(decode_kernel="cuda")
+    with pytest.raises(ValueError, match="does not tile"):
+        ServeConfig(decode_kernel="pallas", decode_block_k=24, max_len=64)
+    with pytest.raises(ValueError, match="sublane"):
+        ServeConfig(decode_kernel="pallas", decode_block_k=4, max_len=64)
+    with pytest.raises(ValueError, match="mid-block"):
+        ServeConfig(decode_kernel="pallas", decode_block_k=16,
+                    prefix_pages=1, prefix_page_tokens=8)
+    # block_k clamps to short caches: one block covering max_len is legal
+    assert ServeConfig(decode_kernel="pallas", max_len=32,
+                       prefill_buckets=(8, 16)).decode_block_k == 128
     assert ServeConfig(decode_steps_per_call=2).decode_window == 2
     assert ServeConfig(spec_decode=3).decode_window == 4
 
@@ -421,6 +438,49 @@ def test_prefix_cow_no_cross_contamination(fast_setup):
     hits = bfm.get_metric("bluefog_serve_prefix_hits_total")
     assert hits is not None and hits.total() >= 1
     assert any(r.prefix_len == 4 for r in reqs)
+
+
+@pytest.fixture(scope="module")
+def flash_setup(cpu_devices):
+    """Two engines differing ONLY in decode_kernel: every fast path on
+    (spec decode + shared prefix pages), xla vs pallas flash decode."""
+    cfg = compose.LMConfig(**_CFG)
+    m = compose.compose_parallelism(2, 2, 2, 1, devices=cpu_devices)
+    params = compose.init_lm_params(cfg, m, seed=3)
+    common = dict(batch_buckets=(1, 2), prefill_buckets=(4, 8, 16),
+                  slots=4, max_len=32, decode_steps_per_call=1,
+                  spec_decode=2, spec_stages=1,
+                  prefix_pages=2, prefix_page_tokens=8)
+    flash = ServeEngine(m, cfg, params, ServeConfig(
+        decode_kernel="pallas", decode_block_k=8, **common))
+    flash.warmup()
+    ref = ServeEngine(m, cfg, params, ServeConfig(**common))
+    ref.warmup()
+    return flash, ref
+
+
+def test_flash_decode_engine_bit_identical(flash_setup):
+    """The serving acceptance gate for the Pallas flash-decode kernel:
+    with identical configs, the kernel engine's token streams ARE the XLA
+    engine's streams — through 1-token decode (flash_attend_rows), the
+    k-token speculative verify (flash_attend_chunk), ragged mixed-length
+    batches, and prefix-hit lanes routed through the shared page."""
+    flash, ref = flash_setup
+    rng = np.random.default_rng(13)
+    shared = rng.integers(0, _CFG["vocab"], 8).tolist()   # one sealed page
+    prompts = [rng.integers(0, _CFG["vocab"], int(n)).tolist()
+               for n in (3, 5, 8, 14)]
+    sharers = [shared + [5, 9, 2], shared + [6, 5, 3, 5]]
+    want = [r.generated for r in _drain(ref, prompts)]
+    want += [r.generated for r in _drain(ref, sharers)]
+    got = [r.generated for r in _drain(flash, prompts)]
+    bfm.reset_metrics()
+    got += [r.generated for r in _drain(flash, sharers)]
+    assert got == want
+    # the prefix-hit kernel path really engaged (a sharer rode the page)
+    hits = bfm.get_metric("bluefog_serve_prefix_hits_total")
+    assert hits is not None and hits.total() >= 1
+    assert bfm.counter("bluefog_retrace_after_warmup_total").total() == 0
 
 
 def test_sampling_determinism(cpu_devices):

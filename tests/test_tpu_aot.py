@@ -29,22 +29,13 @@ pytestmark = pytest.mark.slow
 
 N = 8
 
-# Root cause of the Pallas flash-attention lowering failures on v5e: this
-# jax's Mosaic backend cannot legalize the 'tpu.dynamic_gather' op it
-# emits for the kernels' dynamically-indexed bool mask
-# (vector<8x128xi1> gathered by vector<8x128xi32>), so
-# local_flash_attention (models/transformer.py:212) and
-# _pallas_ring_attention (ops/ring.py:142) die in backend_compile.  The
-# earlier shard_map 'no replication rule for pallas_call' layer of these
-# failures is FIXED (check_vma=False on the test shard_maps); this
-# residual is a toolchain legalization bug, not a kernel contract bug —
-# the same kernels run under interpret=True and on the CPU backend.
-_MOSAIC_DYNAMIC_GATHER = pytest.mark.xfail(
-    reason="jax Mosaic fails to legalize 'tpu.dynamic_gather' "
-           "(vector<8x128xi1> bool-mask gather) when compiling the Pallas "
-           "flash-attention kernels for v5e; shard_map replication fixed "
-           "via check_vma=False, this residual is a Mosaic legalization "
-           "bug", strict=False)
+# History: these flash-kernel lowerings used to xfail because the backward
+# kernel's bool [QB, 1] -> [QB, Tk] lane-broadcast (the isneginf(lse) guard)
+# lowered to a 'tpu.dynamic_gather' on vector<8x128xi1> that Mosaic cannot
+# legalize.  ops/pallas_attention.py now broadcasts lse to the score shape
+# as f32 BEFORE the -inf test (f32 lane-broadcasts legalize fine), so every
+# Pallas kernel in the repo compiles clean for v5e — a regression here
+# should go red, no xfail guard.
 
 
 @pytest.fixture(scope="module")
@@ -153,7 +144,6 @@ def test_fusion_collapses_permute_chains(tpu_mesh):
     assert fused.count("all-reduce") == 0    # gossip never falls back
 
 
-@_MOSAIC_DYNAMIC_GATHER
 def test_pallas_flash_kernels_lower_for_tpu(tpu_mesh):
     """ring_attention(use_pallas) fwd+bwd compiles through Mosaic for v5e —
     the kernels are real TPU programs, not only interpret-mode constructs."""
@@ -389,7 +379,6 @@ def test_bf16_wire_halves_permute_payload(tpu_mesh):
     assert not any(re.search(r"f32\[\d{4,}", lines[l]) for l in starts)
 
 
-@_MOSAIC_DYNAMIC_GATHER
 def test_ulysses_kernels_lower_for_tpu(tpu_mesh):
     """ulysses_attention(use_pallas) fwd+bwd compiles through Mosaic for
     v5e, with the head/sequence re-shard lowering to all-to-all — the
@@ -664,7 +653,6 @@ def test_zigzag_ring_lowers_with_conditional_skip(tpu_mesh):
     assert "conditional" in txt                  # the visibility skips
 
 
-@_MOSAIC_DYNAMIC_GATHER
 def test_zigzag_backward_lowers_through_mosaic(tpu_mesh):
     """grad(zigzag+pallas) compiles for v5e through the dedicated kernel
     backward: 3 forward + 3 backward Mosaic call sites, no dense [C, Tk]
@@ -797,7 +785,47 @@ def test_grouped_moe_kernel_lowers_for_tpu(tpu_mesh):
     assert f"{G * tile},{E_ * F}" not in txt.replace(" ", "")
 
 
-@_MOSAIC_DYNAMIC_GATHER
+def test_flash_decode_kernel_lowers_for_tpu(tpu_mesh):
+    """The paged flash-decode kernel (ops/pallas_decode.py) compiles through
+    Mosaic for v5e on its most demanding configuration: int8 KV pages with
+    fused per-token dequant, GQA folding, and the scalar-prefetched
+    slot/prefix page indirection driving the KV BlockSpec index maps.
+    Compiled replicated over the AOT mesh — no collectives, the same local
+    program the serving hot path runs on one chip."""
+    from bluefog_tpu.ops import pallas_decode as pd
+
+    S, ROWS, H, Hkv, L, Dh = 8, 16, 8, 4, 1024, 128
+
+    def per_rank(q, kl, vl, ksc, vsc, slots, lens, pslots, plens):
+        (q, kl, vl, ksc, vsc, slots, lens, pslots, plens) = jax.tree.map(
+            lambda t: t[0],
+            (q, kl, vl, ksc, vsc, slots, lens, pslots, plens))
+        out = pd.flash_attend_rows(
+            q, kl, vl, slots, lens, k_scale=ksc, v_scale=vsc,
+            prefix_slots=pslots, prefix_lens=plens, block_k=128,
+            interpret=False)
+        return out[None]
+
+    fn = jax.jit(jax.shard_map(
+        per_rank, mesh=tpu_mesh, in_specs=(P("rank"),) * 9,
+        out_specs=P("rank"), check_vma=False))
+    sh = NamedSharding(tpu_mesh, P("rank"))
+    sds = (jax.ShapeDtypeStruct((N, S, H, Dh), jnp.bfloat16, sharding=sh),
+           jax.ShapeDtypeStruct((N, ROWS, Hkv, L, Dh), jnp.int8, sharding=sh),
+           jax.ShapeDtypeStruct((N, ROWS, Hkv, L, Dh), jnp.int8, sharding=sh),
+           jax.ShapeDtypeStruct((N, ROWS, Hkv, L), jnp.float32, sharding=sh),
+           jax.ShapeDtypeStruct((N, ROWS, Hkv, L), jnp.float32, sharding=sh),
+           jax.ShapeDtypeStruct((N, S), jnp.int32, sharding=sh),
+           jax.ShapeDtypeStruct((N, S), jnp.int32, sharding=sh),
+           jax.ShapeDtypeStruct((N, S), jnp.int32, sharding=sh),
+           jax.ShapeDtypeStruct((N, S), jnp.int32, sharding=sh))
+    txt = fn.lower(*sds).compile().as_text()
+    assert txt.count("tpu_custom_call") >= 1
+    # paged reads: no [S, L] x heads dense gathered-KV copy materializes
+    # at full width — the kernel streams (1, 1, block_k, Dh) pages
+    assert f"f32[{S},{Hkv},{L},{Dh}]" not in txt.replace(" ", "")
+
+
 @pytest.mark.parametrize("scan_layers,remat", [
     (False, False),       # stage-0 lm_bench_pallas default (pre-scan era)
     (True, False),        # lm_bench default: scan_layers on

@@ -27,6 +27,7 @@ MODULES = [
     ("bluefog_tpu.ops.ring", "Ring attention (sequence parallelism)"),
     ("bluefog_tpu.ops.ulysses", "Ulysses attention (all-to-all SP)"),
     ("bluefog_tpu.ops.pallas_attention", "Pallas flash-attention kernels"),
+    ("bluefog_tpu.ops.pallas_decode", "Paged flash-decode kernel (serving)"),
     ("bluefog_tpu.parallel.context", "Mesh context (init/topology state)"),
     ("bluefog_tpu.parallel.windows", "Window registry (named windows)"),
     ("bluefog_tpu.parallel.pipeline", "Pipeline parallelism"),

@@ -255,6 +255,17 @@ def _battery_steps(tag: str, stage: int = 0) -> list:
                        "--out",
                        os.path.join(m, f"serve_bench_fast_{tag}.json")],
                       2400, None, None))
+        # the flash-decode row: the paged Pallas decode kernel on the
+        # serving hot path (fused int8 dequant, shared prefix pages) —
+        # gated on kernel-vs-XLA token bit-identity, plus the schema-4
+        # decode-MFU-at-context sweep for both kernels on real silicon
+        steps.append(("serve_bench_flash",
+                      [py, sb, "--train-dp", "2", "--serve-dp", "2",
+                       "--pp", "2", "--decode-kernel", "pallas@8",
+                       "--kv-dtype", "int8", "--prefix-pages", "2x8",
+                       "--out",
+                       os.path.join(m, f"serve_bench_flash_{tag}.json")],
+                      2400, None, None))
         # the scale-event row: bursty flash-crowd traffic with a parked
         # reserve replica — the autoscaler must grow into the spike and
         # the schema-3 trace row demands zero failed requests + SLO
@@ -362,6 +373,12 @@ def _rehearsal_steps(tag: str) -> list:
           "--virtual-cpu", "--smoke", "--spec-decode", "3@1",
           "--kv-dtype", "int8", "--prefix-pages", "2x8",
           "--out", os.path.join(m, f"serve_bench_fast_{tag}.json")], 900,
+         None, None),
+        ("serve_bench_flash",
+         [py, os.path.join(REPO, "tools", "serve_bench.py"),
+          "--virtual-cpu", "--smoke", "--decode-kernel", "pallas@8",
+          "--kv-dtype", "int8", "--prefix-pages", "2x8",
+          "--out", os.path.join(m, f"serve_bench_flash_{tag}.json")], 900,
          None, None),
         ("serve_bench_trace",
          [py, os.path.join(REPO, "tools", "serve_bench.py"),

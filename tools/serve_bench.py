@@ -34,6 +34,15 @@ and, when the fast paths are armed (schema 2 rows):
   the raw layout (the float64 logit-drift bound is pinned in
   ``tests/test_serve_fast.py``).
 
+With ``--decode-kernel pallas[@block_k]`` (schema 4) the engine serves
+through the paged flash-decode Pallas kernel (``ops/pallas_decode.py``)
+instead of the XLA gather-then-attend path; the artifact gains a
+``decode`` section with (a) a kernel-vs-XLA token bit-identity gate on
+the same prompts and (b) decode-MFU-at-context rows — the decode
+attention hot path timed at context x occupancy x KV-dtype points for
+both the configured kernel and the XLA reference, with achieved
+FLOPs/sec against the roofline ceiling.
+
 With ``--traffic-trace`` (schema 3) the drain is followed by a bursty
 traffic phase driven by a synthetic arrival trace (``diurnal`` — one
 day-cycle sinusoid — or ``flash-crowd`` — a low base rate with a sudden
@@ -45,13 +54,15 @@ file on the way) and retire it after the cooldown.  The artifact's
 bound), scale events, and the requeued-vs-failed split — the gate
 demands **zero failed requests** across the scale events.
 
-Emits a ``bluefog-serve-bench-3`` JSON artifact (last stdout line, and
+Emits a ``bluefog-serve-bench-4`` JSON artifact (last stdout line, and
 ``--out``).
 
 Run:    python tools/serve_bench.py --train-dp 2 --serve-dp 2 --pp 2 --out ...
 Smoke:  python tools/serve_bench.py --virtual-cpu --smoke
 Fast:   python tools/serve_bench.py --virtual-cpu --smoke \
             --spec-decode 3@1 --prefix-pages 2x8 --kv-dtype int8
+Flash:  python tools/serve_bench.py --virtual-cpu --smoke \
+            --decode-kernel pallas@8 --kv-dtype int8 --prefix-pages 2x8
 Trace:  python tools/serve_bench.py --virtual-cpu --smoke \
             --traffic-trace flash-crowd
 """
@@ -66,7 +77,7 @@ import time
 REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
 sys.path.insert(0, REPO)
 
-SCHEMA = "bluefog-serve-bench-3"
+SCHEMA = "bluefog-serve-bench-4"
 
 
 def _trace_arrivals(shape, steps, slots, rng):
@@ -182,6 +193,86 @@ def _run_traffic_trace(engine, shape, *, steps, vocab, max_new, rng,
     return row
 
 
+def _decode_attend_bench(scfg, heads, head_dim, *, kernel, block_k,
+                         on_tpu, peak, iters):
+    """Schema-4 decode-MFU-at-context rows.
+
+    Times the decode attention hot path — one new token per lane over a
+    slot-paged KV cache — at context x occupancy (live lanes) x KV-dtype
+    points, for the configured kernel AND the XLA gather-then-attend
+    reference on the same pages.  Attention FLOPs are exact (score +
+    value matmuls over the attended context); MFU is against the trusted
+    roofline ceiling, null off-TPU where interpret-mode Pallas timings
+    grade nothing.
+    """
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from bluefog_tpu.ops import pallas_decode as _pd
+    from bluefog_tpu.serve import kv_cache as _kv
+
+    L, n_rows = scfg.max_len, scfg.slots + 1
+    rng = np.random.default_rng(0)
+    contexts = sorted({max(1, L // 4), max(1, L // 2), L})
+    lanes = sorted({1, max(1, scfg.slots // 2), scfg.slots})
+    dtypes = ["raw"] + ([scfg.kv_dtype] if scfg.kv_dtype != "raw" else [])
+
+    def flash_fn(q, kl, vl, slots, lens, ksc, vsc):
+        return _pd.flash_attend_rows(q, kl, vl, slots, lens,
+                                     k_scale=ksc, v_scale=vsc,
+                                     block_k=block_k)
+
+    def xla_fn(q, kl, vl, slots, lens, ksc, vsc):
+        return _kv.attend_rows(q, kl, vl, slots, lens,
+                               k_scale=ksc, v_scale=vsc)
+
+    fns = {"xla": jax.jit(xla_fn)}
+    if kernel == "pallas":
+        fns["pallas"] = jax.jit(flash_fn)
+
+    def _time(fn, args):
+        fn(*args).block_until_ready()           # compile
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn(*args)
+        out.block_until_ready()
+        return (time.perf_counter() - t0) / iters
+
+    rows = []
+    for store in dtypes:
+        kraw = jnp.asarray(
+            rng.normal(size=(n_rows, heads, L, head_dim)), jnp.float32)
+        vraw = jnp.asarray(
+            rng.normal(size=(n_rows, heads, L, head_dim)), jnp.float32)
+        if store == "raw":
+            kl, vl, ksc, vsc = kraw, vraw, None, None
+        else:
+            kl, ksc = _kv.quantize_rows(kraw, store)
+            vl, vsc = _kv.quantize_rows(vraw, store)
+        for ctx in contexts:
+            for S in lanes:
+                q = jnp.asarray(
+                    rng.normal(size=(S, heads, head_dim)), jnp.float32)
+                slots = jnp.arange(S, dtype=jnp.int32)
+                lens = jnp.full((S,), ctx - 1, jnp.int32)
+                args = (q, kl, vl, slots, lens, ksc, vsc)
+                walls = {name: _time(fn, args) for name, fn in fns.items()}
+                flops = 4.0 * S * heads * head_dim * ctx
+                wall = walls.get("pallas", walls["xla"])
+                rows.append({
+                    "kv_dtype": store,
+                    "context": int(ctx),
+                    "lanes": int(S),
+                    "wall_us": round(wall * 1e6, 2),
+                    "xla_wall_us": round(walls["xla"] * 1e6, 2),
+                    "attn_flops": flops,
+                    "flops_per_sec": round(flops / wall, 1) if wall else None,
+                    "mfu": (round(flops / wall / peak, 8)
+                            if on_tpu and peak and wall else None),
+                })
+    return rows
+
+
 def _load_tool(name):
     spec = importlib.util.spec_from_file_location(
         name + "_mod", os.path.join(REPO, name + ".py"))
@@ -229,6 +320,9 @@ def main():
     ap.add_argument("--prefix-pages", default=None,
                     help="shared prefix pages: '<pages>' or "
                          "'<pages>x<page_tokens>' (default off)")
+    ap.add_argument("--decode-kernel", default=None,
+                    help="decode-attention backend: 'xla' or 'pallas' or "
+                         "'pallas@<block_k>' (schema 4 row; default xla)")
     ap.add_argument("--traffic-trace", default=None,
                     choices=("diurnal", "flash-crowd"),
                     help="bursty traffic phase with a parked reserve "
@@ -321,6 +415,11 @@ def main():
             sc_kw["spec_stages"] = int(st_s)
     if args.kv_dtype:
         sc_kw["kv_dtype"] = args.kv_dtype
+    if args.decode_kernel:
+        kern, _, bk_s = args.decode_kernel.partition("@")
+        sc_kw["decode_kernel"] = kern       # ServeConfig validates the token
+        if bk_s:
+            sc_kw["decode_block_k"] = int(bk_s)
     if args.prefix_pages:
         pg_s, _, pt_s = args.prefix_pages.partition("x")
         sc_kw["prefix_pages"] = int(pg_s)
@@ -391,6 +490,21 @@ def main():
             "tokens_identical": bool(cold.generated == hit.generated)}
     else:
         shared = None
+
+    # probe (c): flash-decode bit-identity — the pallas-kernel engine must
+    # emit the same greedy token streams as the XLA gather-then-attend path
+    flash_probe = None
+    if scfg.decode_kernel == "pallas":
+        probe_prompts = [rng.integers(0, vocab, int(rng.integers(
+            2, scfg.prefill_buckets[-1] + 1))).tolist() for _ in range(3)]
+        ref_eng = ServeEngine(serve_m, cfg, serve_params,
+                              dataclasses.replace(scfg, decode_kernel="xla"))
+        ref_eng.warmup()
+        ref = [r.generated for r in _drain_tokens(ref_eng, probe_prompts)]
+        got = [r.generated for r in _drain_tokens(engine, probe_prompts)]
+        flash_probe = {"prompts": len(probe_prompts),
+                       "bit_identical": bool(ref == got)}
+        del ref_eng
 
     refresher = WeightRefresher(engine, train_m, every=refresh_every)
     sched = Scheduler(engine)
@@ -516,6 +630,19 @@ def main():
             "ratio": round(bpt / raw_bpt, 4),
         }
 
+    # -- flash-decode rows (schema 4) ----------------------------------------
+    decode_doc = None
+    if scfg.decode_kernel == "pallas":
+        decode_doc = {
+            "kernel": scfg.decode_kernel,
+            "block_k": scfg.decode_block_k,
+            **flash_probe,
+            "attend": _decode_attend_bench(
+                scfg, heads, d_model // heads, kernel=scfg.decode_kernel,
+                block_k=scfg.decode_block_k, on_tpu=on_tpu, peak=peak,
+                iters=3 if smoke else 20),
+        }
+
     doc = {
         "schema": SCHEMA,
         "ok": True,
@@ -564,6 +691,7 @@ def main():
         "spec": spec_doc,
         "prefix": prefix_doc,
         "kv": kv_doc,
+        "decode": decode_doc,
         "trace": trace_doc,
         "invariants": {
             "donation_intact": bool(cache_probe.is_deleted()),
@@ -579,6 +707,8 @@ def main():
                         and prefix_doc["hits"] >= 1)
     if kv_doc is not None and scfg.kv_dtype == "int8":
         fast_ok &= kv_doc["ratio"] <= 0.5
+    if decode_doc is not None:
+        fast_ok &= decode_doc["bit_identical"]
     doc["ok"] = bool(len(sched.completed) == n_requests
                      and doc["invariants"]["donation_intact"]
                      and retraces == 0

@@ -6,7 +6,8 @@ PY ?= python
 
 .PHONY: test test-fast test_basic test_ops test_win_ops test_optimizer \
 	test_hier test_native test_examples verify native clean hw-watch \
-	obs-smoke chaos-smoke overlap-smoke postmortem-smoke pod-smoke \
+	obs-smoke obs-trace-smoke chaos-smoke overlap-smoke postmortem-smoke \
+	pod-smoke \
 	autotune-smoke elastic-smoke lm-smoke moe-smoke moe-fast-smoke \
 	serve-smoke \
 	serve-fast-smoke flash-decode-smoke \
@@ -93,6 +94,32 @@ obs-smoke:
 		assert r['ok'] and r['n_hosts'] == 2 and all(k in r for k in \
 		('metrics', 'series', 'summary')), r; \
 		print('obs-smoke OK')"
+
+# request-tracing smoke: the span/timeseries/SLO pytest battery (including
+# the traced 8-rank estate drill and the flash-crowd burn-rate acceptance)
+# plus trace_report over the committed two-rank bundles with a schema +
+# critical-path check — bundle/report format drift fails here (and in
+# tier-1, via the same fixtures in tests/test_tracing.py)
+obs-trace-smoke:
+	$(PY) -m pytest tests/test_tracing.py -q
+	$(PY) tools/trace_report.py \
+		tests/fixtures/trace_rank0.trace.jsonl \
+		tests/fixtures/trace_rank1.trace.jsonl \
+		--out /tmp/obs_trace_report.json \
+		--chrome /tmp/obs_chrome_trace.json
+	$(PY) -c "import json; \
+		d = json.load(open('/tmp/obs_trace_report.json')); \
+		assert d['ok'] and d['schema'] == 'bluefog-trace-report-1', d; \
+		assert d['n_ranks'] == 2 and d['ranks'] == [0, 1], d; \
+		r = d['requests']['req-r0-1']; \
+		assert abs(r['queue_s'] + r['prefill_s'] + r['decode_s'] \
+		+ r['gap_s'] - r['total_s']) < 1e-9, r; \
+		assert d['critical_path'][0][0] == 'req-r0-1', d; \
+		assert d['train']['steps'] == 2, d; \
+		c = json.load(open('/tmp/obs_chrome_trace.json')); \
+		assert c['traceEvents'] and any(e['ph'] == 'X' \
+		for e in c['traceEvents']), c; \
+		print('obs-trace-smoke OK')"
 
 # pipelined-gossip smoke: the CPU-feasible overlap battery (delayed-CTA
 # trajectory/HLO/contract tests, round-parallel equivalence) plus a schema

@@ -52,6 +52,7 @@ from .schedule import CommSchedule
 from .utils import chaos as _chaos
 from .utils import flight as _flight
 from .utils import metrics as _metrics
+from .utils import tracing as _tracing
 from .utils.timeline import named_span
 
 Axis = str
@@ -1706,6 +1707,7 @@ class _InstrumentedStep:
         self._warmup = max(int(warmup), 1)
         self._calls = 0
         self._jit_cache_baseline: Optional[int] = None
+        self._trace = ""                 # minted lazily when tracing is armed
 
     def __getattr__(self, name):
         fn = self.__dict__.get("_fn")
@@ -1723,6 +1725,10 @@ class _InstrumentedStep:
         import time as _time
         call = self._calls + 1
         _flight.record("step_begin", name="train_step", step=call)
+        traced = _tracing.enabled()
+        if traced and not self._trace:
+            self._trace = _tracing.new_trace("train")
+        tm0 = _time.monotonic() if traced else 0.0
         t0 = _time.perf_counter()
         try:
             # fault injection (zero-cost gate when no plan is installed): a
@@ -1754,6 +1760,13 @@ class _InstrumentedStep:
         _flight.record("step_end", name="train_step", step=self._calls,
                        dur_s=round(dt, 6), fused_k=self._steps_per_call,
                        overlap=self._overlap, donated=self._donated)
+        if traced:
+            # the gossip round rides inside the fused step program, so the
+            # span covers compute + communication of this call
+            _tracing.add_span(self._trace, "train_step", tm0,
+                              _time.monotonic(), cat="train",
+                              step=self._calls, fused_k=self._steps_per_call,
+                              overlap=self._overlap)
         from . import diagnostics as _diag
         # per-rank step-time table every call (a host-side numpy fill):
         # chaos-injected sleeps are attributed per step, not lumped into
@@ -1761,11 +1774,16 @@ class _InstrumentedStep:
         step_times = _diag.observe_step_time(dt)
         k = self._metrics_every_k
         if k and (self._calls == 1 or self._calls % k == 0):
+            tp0 = _time.monotonic() if traced else 0.0
             _diag.diagnose_consensus(out[0], step_times=step_times)
             # async-gossip states carry their staleness depth in the step
             # output — a pure host read, no extra collective or compile
             if len(out) > 1:
                 _diag.observe_async_staleness(out[1])
+            if traced:
+                _tracing.add_span(self._trace, "consensus_probe", tp0,
+                                  _time.monotonic(), cat="train",
+                                  step=self._calls)
         if self._calls >= self._warmup:
             size = self._jit_cache_len()
             if (_metrics.in_steady_state() and size is not None

@@ -49,6 +49,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import time
 from typing import Any, Optional, Sequence, Tuple
 
 import jax
@@ -64,6 +65,7 @@ from ..ops.ulysses import dense_attention
 from ..parallel.compose import AXES, LMConfig, Mesh3D, _ln, draft_carve
 from ..utils import flight as _flight
 from ..utils import metrics as _metrics
+from ..utils import tracing as _tracing
 from . import kv_cache as _kv
 
 __all__ = ["ServeConfig", "ServeEngine"]
@@ -380,6 +382,12 @@ class ServeEngine:
         self._slot_keys = np.zeros((m.dp, cc.rows, 2), np.uint32)
         self._seed_count = 0
         self._warm_sizes: Optional[Tuple[int, ...]] = None
+        self._engine_trace = ""          # minted lazily when tracing is armed
+
+    def _trace_id(self) -> str:
+        if not self._engine_trace:
+            self._engine_trace = _tracing.new_trace("engine")
+        return self._engine_trace
 
     # ------------------------------------------------------------------
     # device-side bodies (per-device shapes, leading [1, ...] sliced off)
@@ -726,12 +734,19 @@ class ServeEngine:
         slot_id[replica] = row
         true_len = np.ones((R,), np.int32)
         true_len[replica] = len(tokens)
+        traced = _tracing.enabled()
+        t0 = time.monotonic() if traced else 0.0
         nxt, logits, self.cache = self._prefill_jit(
             self.params, self.cache, self._expand(toks),
             self._expand(slot_id), self._expand(true_len))
         self._check_retrace(f"prefill Tpad={Tpad}")
-        return (int(self._collect(nxt)[replica]),
-                self._collect(logits)[replica])
+        out = (int(self._collect(nxt)[replica]),
+               self._collect(logits)[replica])
+        if traced:
+            _tracing.add_span(self._trace_id(), "prefill_call", t0,
+                              time.monotonic(), cat="engine", Tpad=Tpad,
+                              replica=replica)
+        return out
 
     def chunk_prefill(self, replica: int, slot: int, tokens: Sequence[int],
                       start: int, prefix_row: int) -> int:
@@ -770,6 +785,8 @@ class ServeEngine:
 
     def _chunk_call(self, toks, slots, lens, prows, plens) -> np.ndarray:
         prows, plens = self._prefix_args(prows, plens, toks.shape[1])
+        traced = _tracing.enabled()
+        t0 = time.monotonic() if traced else 0.0
         gen, self.cache = self._chunk_jit(
             self.params, self.cache,
             self._expand(np.asarray(toks, np.int32)),
@@ -778,7 +795,12 @@ class ServeEngine:
             self._expand(prows) if prows is not None else None,
             self._expand(plens) if plens is not None else None)
         self._check_retrace(f"chunk S={toks.shape[1]} T={toks.shape[2]}")
-        return self._collect(gen)
+        out = self._collect(gen)
+        if traced:
+            _tracing.add_span(self._trace_id(), "chunk_call", t0,
+                              time.monotonic(), cat="engine",
+                              S=int(toks.shape[1]), T=int(toks.shape[2]))
+        return out
 
     def decode(self, tokens: np.ndarray, slots: np.ndarray,
                lens: np.ndarray, prefix_rows: Optional[np.ndarray] = None,
@@ -802,6 +824,8 @@ class ServeEngine:
         slots = np.asarray(slots, np.int32)
         prows, plens = self._prefix_args(prefix_rows, prefix_lens, S)
         keys = self._gather_keys(slots)
+        traced = _tracing.enabled()
+        t0 = time.monotonic() if traced else 0.0
         gen, keys, self.cache = self._decode_jit(
             self.params, self.cache,
             self._expand(np.asarray(tokens, np.int32)),
@@ -812,7 +836,11 @@ class ServeEngine:
             self._expand(keys))
         self._scatter_keys(slots, self._collect(keys))
         self._check_retrace(f"decode S={S}")
-        return self._collect(gen)
+        out = self._collect(gen)
+        if traced:
+            _tracing.add_span(self._trace_id(), "decode_call", t0,
+                              time.monotonic(), cat="engine", S=int(S))
+        return out
 
     def spec_decode(self, tokens: np.ndarray, slots: np.ndarray,
                     lens: np.ndarray,
@@ -842,6 +870,8 @@ class ServeEngine:
             raise ValueError(f"batch lane count {S} is not a declared "
                              f"bucket {self.scfg.batch_buckets}")
         prows, plens = self._prefix_args(prefix_rows, prefix_lens, S)
+        traced = _tracing.enabled()
+        t0 = time.monotonic() if traced else 0.0
         drafts, self.cache = self._draft_jit(
             self.params, self.cache, self._expand(tokens),
             self._expand(slots), self._expand(lens),
@@ -877,6 +907,10 @@ class ServeEngine:
             _metrics.counter(
                 "bluefog_serve_spec_accepted_total",
                 "draft tokens accepted by the verify pass").inc(accepted)
+        if traced:
+            _tracing.add_span(self._trace_id(), "spec_round", t0,
+                              time.monotonic(), cat="engine", S=int(S), k=k,
+                              drafted=drafted, accepted=accepted)
         return emitted, counts
 
     def idle_lane(self) -> Tuple[int, int, int]:

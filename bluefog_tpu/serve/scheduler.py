@@ -46,6 +46,8 @@ import numpy as np
 
 from ..utils import flight as _flight
 from ..utils import metrics as _metrics
+from ..utils import timeseries as _ts
+from ..utils import tracing as _tracing
 from .engine import ServeEngine
 from .kv_cache import PrefixCache, SlotAllocator
 
@@ -68,9 +70,12 @@ class Request:
     prefix_len: int = 0              # tokens served by that page
     generated: List[int] = dataclasses.field(default_factory=list)
     submitted_at: float = 0.0
+    admitted_at: Optional[float] = None
     first_token_at: Optional[float] = None
     finished_at: Optional[float] = None
     requeued: int = 0                # replica-failure evictions survived
+    requeued_at: Optional[float] = None   # last eviction time (queue spans)
+    trace_id: str = ""               # request-scoped trace (utils.tracing)
 
     @property
     def next_pos(self) -> int:
@@ -108,6 +113,8 @@ class Scheduler:
         self.completed: List[Request] = []
         self.failed: List[Request] = []
         self.requeued_total = 0
+        self._decode_calls = 0
+        self._slo = None                 # diagnostics.SLOEngine, if attached
         _flight.register_block("serve", self._flight_block)
 
     # ------------------------------------------------------------------
@@ -122,6 +129,13 @@ class Scheduler:
                       max_new_tokens=max_new_tokens,
                       submitted_at=time.monotonic() if now is None else now)
         self._next_id += 1
+        # process-global counter, not req.id: several schedulers can live in
+        # one process (probe drains, benches) and each restarts ids at 0 —
+        # keyed ids would collide and merge span trees across requests
+        req.trace_id = _tracing.new_trace("req")
+        _tracing.mark(req.trace_id, "submit", cat="serve", req=req.id,
+                      prompt_len=len(req.prompt),
+                      max_new_tokens=req.max_new_tokens)
         self._queue.append(req)
         return req
 
@@ -182,6 +196,7 @@ class Scheduler:
             req.generated.clear()          # KV died with the replica
             req.first_token_at = None
             req.requeued += 1
+            req.requeued_at = time.monotonic()
             self.requeued_total += 1
             _metrics.counter(
                 "bluefog_requests_total",
@@ -229,7 +244,17 @@ class Scheduler:
         this cycle."""
         self._admit()
         retired = self._decode_once()
+        _metrics.gauge("bluefog_serve_queue_depth",
+                       "admission-queue depth after each scheduler step"
+                       ).set(self.pending)
+        if self._slo is not None:
+            self._slo.observe(self)
         return retired
+
+    def attach_slo(self, engine) -> None:
+        """Attach an SLO engine (``diagnostics.SLOEngine``); its
+        ``observe(sched)`` runs after every step."""
+        self._slo = engine
 
     def drain(self, max_steps: int = 10_000) -> None:
         """Run until every submitted request reaches a terminal state."""
@@ -245,6 +270,7 @@ class Scheduler:
         """Prefill one admitted request — through a shared prefix page when
         one matches — and return its first token.  Observes the TTFT
         histogram with the hit/cold split."""
+        t0 = time.monotonic()
         r, pc = req.replica, self._prefix[req.replica]
         hit = False
         if pc is not None:
@@ -276,6 +302,10 @@ class Scheduler:
             "time to first token, by prefix-cache outcome",
             buckets=LATENCY_BUCKETS).observe(
                 req.first_token_at - req.submitted_at)
+        _tracing.add_span(req.trace_id, "prefill", t0, req.first_token_at,
+                          cat="serve", hit=hit, replica=r,
+                          prompt_len=len(req.prompt),
+                          prefix_len=req.prefix_len)
         return first
 
     def _admit(self) -> None:
@@ -307,6 +337,15 @@ class Scheduler:
             slot = self._alloc[target].alloc()
             req.replica, req.slot, req.state = target, slot, "running"
             t0 = time.monotonic()
+            req.admitted_at = t0
+            # a requeued request's second wait starts at eviction, not at
+            # submit — starting at submitted_at would double-count the
+            # first wait and let summed queue spans exceed the E2E total
+            q0 = (req.requeued_at if req.requeued_at is not None
+                  else req.submitted_at)
+            _tracing.add_span(req.trace_id, "queue", q0, t0,
+                              cat="serve", replica=target,
+                              requeued=req.requeued)
             first = self._prefill_request(req)
             req.generated.append(first)
             _metrics.counter(
@@ -357,6 +396,8 @@ class Scheduler:
             steps = gen.shape[1]                          # [R, steps, S]
             gen_tokens = lambda r, i: [int(t) for t in gen[r, :, i]]
         dt = time.monotonic() - t0
+        self._decode_calls += 1
+        traced = _tracing.enabled()
         n_tokens = 0
         retired: List[Request] = []
         for r in range(R):
@@ -366,6 +407,19 @@ class Scheduler:
                 new = gen_tokens(r, i)[:room]
                 req.generated.extend(new)
                 n_tokens += len(new)
+                if traced:
+                    # one fused call covers every lane: each rider gets the
+                    # same [t0, t0+dt) span, tagged with ITS token yield
+                    if scfg.spec_decode:
+                        _tracing.add_span(
+                            req.trace_id, "decode", t0, t0 + dt, cat="serve",
+                            call=self._decode_calls, tokens=len(new),
+                            accepted=int(counts[r, i]),
+                            rejected=int(scfg.spec_decode - counts[r, i] + 1))
+                    else:
+                        _tracing.add_span(
+                            req.trace_id, "decode", t0, t0 + dt, cat="serve",
+                            call=self._decode_calls, tokens=len(new))
                 done = self._maybe_retire(req)
                 if done:
                     retired.append(req)
@@ -395,6 +449,13 @@ class Scheduler:
         self._alloc[req.replica].free(req.slot)
         if req.prefix_row >= 0:
             self._prefix[req.replica].release(req.prefix_row)
+        # root span: its [submitted_at, finished_at) duration IS the
+        # request's measured E2E latency — trace_report checks children
+        # against it
+        _tracing.add_span(req.trace_id, "request", req.submitted_at,
+                          req.finished_at, cat="serve",
+                          tokens=len(req.generated), replica=req.replica,
+                          requeued=req.requeued)
         self.completed.append(req)
         _metrics.counter(
             "bluefog_requests_total",
@@ -407,6 +468,7 @@ class Scheduler:
 
     def _flight_block(self) -> dict:
         """The ``serve`` bundle block postmortem reads after a chaos kill."""
+        now = time.monotonic()
         block = {
             "replicas": self.replicas,
             "dead_replicas": sorted(self._dead),
@@ -415,6 +477,19 @@ class Scheduler:
             "in_flight": {str(r): sorted(req.id
                                          for req in self._active[r].values())
                           for r in range(self.replicas) if self._active[r]},
+            # per-request detail at dump time: trace ids + ages, so a
+            # postmortem names the requests a dead replica took down
+            "in_flight_traces": {
+                str(r): [{"id": req.id, "trace": req.trace_id,
+                          "age_s": round(now - req.submitted_at, 6),
+                          "queue_s": round(
+                              (req.admitted_at if req.admitted_at is not None
+                               else now) - req.submitted_at, 6)}
+                         for _, req in sorted(self._active[r].items())]
+                for r in range(self.replicas) if self._active[r]},
+            "queued": [{"id": q.id, "trace": q.trace_id,
+                        "age_s": round(now - q.submitted_at, 6)}
+                       for q in list(self._queue)[:16]],
             "last_request_ids": {str(r): ids for r, ids
                                  in enumerate(self._last_ids) if ids},
             "completed": len(self.completed),
@@ -435,8 +510,12 @@ class AutoScaler:
     """SLO-driven serve autoscaling: breaches write the scale file.
 
     Watches two signals after every :meth:`Scheduler.step` — the
-    admission-queue depth and an EWMA of the p99 of the existing
-    ``bluefog_serve_token_latency_seconds`` histogram — and closes the
+    admission-queue depth and a trailing-window p99 of
+    ``bluefog_serve_token_latency_seconds`` read from the time-series
+    store (:mod:`bluefog_tpu.utils.timeseries`; the scaler arms the
+    ring itself, and falls back to an EWMA over the histogram's
+    reservoir percentile for observations that predate arming) — and
+    closes the
     elastic loop: a sustained breach *grows* the serving fleet (restores
     the lowest PARKED replica — one retired by this scaler, whose slice
     is intact; a chaos-killed replica's KV died with it and is never
@@ -465,6 +544,7 @@ class AutoScaler:
                  scale_file: Optional[str] = None,
                  min_replicas: int = 1,
                  alpha: float = 0.2,
+                 window_s: float = 60.0,
                  ranks_per_replica: Optional[int] = None):
         from ..utils.config import env_float
         if slo_p99_s is None:
@@ -490,6 +570,13 @@ class AutoScaler:
                 f"ranks_per_replica must be >= 1, got {ranks_per_replica}")
         self.ranks_per_replica = int(ranks_per_replica)
         self.alpha = float(alpha)
+        if window_s <= 0:
+            raise ValueError(f"window_s must be > 0, got {window_s}")
+        self.window_s = float(window_s)
+        # every future latency observation also lands in a bounded ring;
+        # observe() scores the trailing window instead of the lifetime
+        # reservoir
+        _ts.arm("bluefog_serve_token_latency_seconds")
         self.ewma_p99: Optional[float] = None
         self.events: List[dict] = []
         self._step = 0
@@ -534,14 +621,22 @@ class AutoScaler:
         """Fold in one scheduler step; returns the scale event if one
         fired.  Call once per :meth:`Scheduler.step`."""
         self._step += 1
-        p99 = _metrics.histogram(
-            "bluefog_serve_token_latency_seconds",
-            "per-token serve latency (prefill + decode)",
-            buckets=LATENCY_BUCKETS).percentile(99)
+        # primary: exact p99 over the trailing window of the armed ring
+        p99 = _ts.percentile("bluefog_serve_token_latency_seconds", 99,
+                             window_s=self.window_s)
+        if p99 is None:
+            # ring empty (observations predate arming): EWMA over the
+            # lifetime reservoir percentile, the pre-timeseries behavior
+            raw = _metrics.histogram(
+                "bluefog_serve_token_latency_seconds",
+                "per-token serve latency (prefill + decode)",
+                buckets=LATENCY_BUCKETS).percentile(99)
+            if raw is not None:
+                p99 = (raw if self.ewma_p99 is None else
+                       self.alpha * raw + (1.0 - self.alpha) * self.ewma_p99)
         if p99 is not None:
-            self.ewma_p99 = (p99 if self.ewma_p99 is None else
-                             self.alpha * p99
-                             + (1.0 - self.alpha) * self.ewma_p99)
+            self.ewma_p99 = p99
+            _ts.append("bluefog_serve_p99_s", p99)
         if self._step - self._last_action_step < self.cooldown_steps:
             return None
         sched = self.sched

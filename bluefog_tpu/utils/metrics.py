@@ -36,6 +36,7 @@ from __future__ import annotations
 import http.server
 import json
 import os
+import sys
 import threading
 import time
 from collections import deque
@@ -79,6 +80,9 @@ class _Metric:
     def __init__(self, name: str, help: str = ""):
         self.name = name
         self.help = help
+        # opt-in history ring (bluefog_tpu.utils.timeseries.arm attaches
+        # one); unarmed metrics pay exactly this None on their hot path
+        self._ts = None
 
 
 class Counter(_Metric):
@@ -94,7 +98,10 @@ class Counter(_Metric):
             raise ValueError("counters only go up")
         key = _label_key(labels)
         with _lock:
-            self._values[key] = self._values.get(key, 0.0) + amount
+            self._values[key] = v = self._values.get(key, 0.0) + amount
+        ts = self._ts
+        if ts is not None:
+            ts.append(v)
 
     def value(self, **labels) -> float:
         with _lock:
@@ -121,6 +128,9 @@ class Gauge(_Metric):
     def set(self, value: float, **labels) -> None:
         with _lock:
             self._values[_label_key(labels)] = float(value)
+        ts = self._ts
+        if ts is not None:
+            ts.append(value)
 
     def value(self, **labels) -> Optional[float]:
         with _lock:
@@ -144,9 +154,12 @@ class Gauge_EWMA(Gauge):
         key = _label_key(labels)
         with _lock:
             prev = self._values.get(key)
-            self._values[key] = (float(value) if prev is None
-                                 else self.alpha * float(value)
-                                 + (1 - self.alpha) * prev)
+            self._values[key] = v = (float(value) if prev is None
+                                     else self.alpha * float(value)
+                                     + (1 - self.alpha) * prev)
+        ts = self._ts
+        if ts is not None:
+            ts.append(v)
 
 
 class Histogram(_Metric):
@@ -179,6 +192,9 @@ class Histogram(_Metric):
                 if v <= b:
                     self._counts[i] += 1
                     break
+        ts = self._ts
+        if ts is not None:
+            ts.append(v)
 
     def percentile(self, q: float) -> Optional[float]:
         """Exact percentile over the recent reservoir (None when empty)."""
@@ -204,6 +220,12 @@ def _get_or_create(cls, name: str, help: str, **kw):
         m = _registry.get(name)
         if m is None:
             m = cls(name, help, **kw)
+            # re-attach an armed history ring across reset_metrics() —
+            # guarded on the module already being loaded so jax-free
+            # processes that never arm a ring skip the lookup entirely
+            ts_mod = sys.modules.get("bluefog_tpu.utils.timeseries")
+            if ts_mod is not None:
+                m._ts = ts_mod._ring_for(name)
             _registry[name] = m
         elif not isinstance(m, cls) and type(m) is not cls:
             raise TypeError(
@@ -241,12 +263,17 @@ def snapshot() -> Dict[str, dict]:
 
 
 def reset_metrics() -> None:
-    """Drop every metric and the steady-state flag (test isolation)."""
+    """Drop every metric and the steady-state flag (test isolation).
+    Armed time-series rings keep their arming but drop their points —
+    history must not leak across registry resets."""
     global _steady, _warned_retrace
     with _lock:
         _registry.clear()
         _steady = False
         _warned_retrace = False
+    ts_mod = sys.modules.get("bluefog_tpu.utils.timeseries")
+    if ts_mod is not None:
+        ts_mod._clear_points()
 
 
 # ---------------------------------------------------------------------------

@@ -269,13 +269,26 @@ def _battery_steps(tag: str, stage: int = 0) -> list:
         # the scale-event row: bursty flash-crowd traffic with a parked
         # reserve replica — the autoscaler must grow into the spike and
         # the schema-3 trace row demands zero failed requests + SLO
-        # recovery under the bound on real hardware too
+        # recovery under the bound on real hardware too.  BLUEFOG_TRACE
+        # banks the per-rank span bundle next to the artifact so the
+        # trace_report step below can merge it into a Chrome trace.
         steps.append(("serve_bench_trace",
                       [py, sb, "--train-dp", "2", "--serve-dp", "2",
                        "--pp", "2", "--traffic-trace", "flash-crowd",
                        "--out",
                        os.path.join(m, f"serve_bench_trace_{tag}.json")],
-                      2400, None, None))
+                      2400, None,
+                      {"BLUEFOG_TRACE":
+                       os.path.join(m, f"trace_serve_{tag}")}))
+        # local merge of the banked span bundles: critical-path report +
+        # chrome://tracing file for the serving drill above
+        steps.append(("trace_report",
+                      [py, os.path.join(REPO, "tools", "trace_report.py"),
+                       "--dir", os.path.join(m, f"trace_serve_{tag}"),
+                       "--out", os.path.join(m, f"trace_report_{tag}.json"),
+                       "--chrome",
+                       os.path.join(m, f"chrome_trace_{tag}.json")],
+                      600, None, None))
     # the async-gossip headline: one rank throttled 10x on the real mesh,
     # async wall-clock-to-consensus vs lockstep on the same push schedule
     # (cheap: two small-strategy compiles, tens of gossip ticks)
@@ -384,7 +397,13 @@ def _rehearsal_steps(tag: str) -> list:
          [py, os.path.join(REPO, "tools", "serve_bench.py"),
           "--virtual-cpu", "--smoke", "--traffic-trace", "flash-crowd",
           "--out", os.path.join(m, f"serve_bench_trace_{tag}.json")], 900,
-         None, None),
+         None, {"BLUEFOG_TRACE": os.path.join(m, f"trace_serve_{tag}")}),
+        ("trace_report",
+         [py, os.path.join(REPO, "tools", "trace_report.py"),
+          "--dir", os.path.join(m, f"trace_serve_{tag}"),
+          "--out", os.path.join(m, f"trace_report_{tag}.json"),
+          "--chrome", os.path.join(m, f"chrome_trace_{tag}.json")], 300,
+         None, {"JAX_PLATFORMS": "cpu"}),
         ("async_frontier",
          [py, os.path.join(REPO, "tools", "gossip_bench.py"),
           "--async-frontier", "--virtual-cpu", "--params", "2048",
@@ -452,7 +471,7 @@ def _is_cpu_payload(payload):
 # artifacts): exempt from the wedge settle/re-probe and still run after
 # a dead-tunnel abort — PERFORMANCE.md must be filled from whatever the
 # tunnel-dialing steps managed to bank
-LOCAL_STEPS = frozenset({"trace_analyze", "perf_fill"})
+LOCAL_STEPS = frozenset({"trace_analyze", "trace_report", "perf_fill"})
 
 
 def run_battery(tag: str, stub: bool, no_commit: bool,

@@ -24,6 +24,11 @@ Output schema (stable, pinned by tests/test_metrics.py):
      "metrics": {name: {"type": ..., ...merged...}},
      "series": {name: [[ts, host, value], ...]},
      "summary": {...metrics-summary-shaped block...}}
+
+Every merged histogram additionally carries a ``percentiles`` row —
+p50/p90/p99 linearly interpolated from the merged cumulative buckets
+(the job-level estimate; per-host reservoirs don't merge) — and the
+``summary.step_time_s`` block repeats them for the operator headline.
 """
 import argparse
 import json
@@ -84,6 +89,39 @@ def _merge_histogram(acc, doc, notes):
         slot[1] += c
 
 
+def _bucket_percentile(buckets, q):
+    """q-th percentile (0..100) interpolated from per-bucket counts
+    ``[[le, count], ...]`` with a trailing ``+Inf`` bucket.
+
+    Linear interpolation inside the winning bucket (Prometheus
+    ``histogram_quantile`` shape); an answer landing in the ``+Inf``
+    bucket clamps to the last finite bound.  None when empty.
+    """
+    total = sum(c for _, c in buckets)
+    if not total:
+        return None
+    target = q / 100.0 * total
+    cum = 0
+    lo = 0.0
+    last_finite = next((b for b, _ in reversed(buckets) if b != "+Inf"), 0.0)
+    for le, c in buckets:
+        prev_cum, cum = cum, cum + c
+        if cum >= target:
+            if le == "+Inf":
+                return float(last_finite)
+            if c == 0:
+                return float(le)
+            frac = (target - prev_cum) / c
+            return float(lo) + frac * (float(le) - float(lo))
+        if le != "+Inf":
+            lo = le
+    return float(last_finite)
+
+
+def _bucket_percentiles(buckets):
+    return {f"p{q}": _bucket_percentile(buckets, q) for q in (50, 90, 99)}
+
+
 def _merge_gauge(acc, doc, host):
     per_host = acc.setdefault("per_host", {})
     for k, v in doc.get("values", {}).items():
@@ -123,6 +161,8 @@ def merge(host_samples):
             else:
                 _merge_gauge(acc, doc, host)
     for name, acc in merged.items():
+        if acc["type"] == "histogram" and acc.get("buckets"):
+            acc["percentiles"] = _bucket_percentiles(acc["buckets"])
         if acc["type"] not in ("counter", "histogram"):
             vals = [v for per_key in acc.get("per_host", {}).values()
                     for v in per_key.values()]
@@ -158,6 +198,7 @@ def _summary(merged):
             "count": h["count"],
             "mean": h["sum"] / h["count"],
             "buckets": h["buckets"],
+            **_bucket_percentiles(h["buckets"]),
         }
     out["comm_bytes_total"] = ctot("bluefog_op_bytes_total")
     hits = ctot("bluefog_compile_cache_hits_total")

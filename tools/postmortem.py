@@ -221,8 +221,12 @@ def _step_time_block(bundles, per_rank):
 
 def _serve_block(bundles, notes):
     """Merge the bundles' ``serve`` blocks (scheduler state at dump time):
-    per-bundle last-request ids, dead replicas, in-flight work.  Present
-    only when at least one bundle came from a serving process."""
+    per-bundle last-request ids, dead replicas, in-flight work — and NAME
+    the requests a dead replica took down (id + trace id + age), not just
+    count them.  The kill/eviction event records which request ids it
+    requeued; the dump-time ``in_flight_traces``/``queued`` tables carry
+    those ids' trace ids and ages.  Present only when at least one bundle
+    came from a serving process."""
     merged = {}
     for rank in sorted(bundles):
         sv = bundles[rank].get("serve")
@@ -239,11 +243,42 @@ def _serve_block(bundles, notes):
                    for d in sv.get("dead_replicas", ())})
     lost = sorted({r for sv in merged.values()
                    for r in sv.get("failed", ())})
-    return {
+    # id -> {trace, age_s, ...} from every dump-time request table
+    by_id = {}
+    for sv in merged.values():
+        for entries in sv.get("in_flight_traces", {}).values():
+            for e in entries:
+                by_id[e.get("id")] = e
+        for e in sv.get("queued", ()):
+            by_id.setdefault(e.get("id"), e)
+    # which replica's death/eviction requeued which request ids
+    victims = {}
+    for rank in sorted(bundles):
+        for ev in bundles[rank].get("events", ()):
+            if (ev.get("kind") == "serve"
+                    and str(ev.get("name", "")).startswith("replica_")
+                    and ev.get("requeued_requests")):
+                victims.setdefault(ev.get("replica"), []).extend(
+                    ev["requeued_requests"])
+    lost_requests = {}
+    for replica, ids in sorted(victims.items(),
+                               key=lambda kv: (kv[0] is None, kv[0])):
+        rows = [dict(by_id.get(i, {}), id=i) for i in sorted(set(ids))]
+        lost_requests[str(replica)] = rows
+        named = ", ".join(
+            f"req {r['id']}" + (f" (trace {r['trace']}, "
+                                f"age {r['age_s']:.3f}s)"
+                                if r.get("trace") else "")
+            for r in rows)
+        notes.append(f"replica {replica} went down holding: {named}")
+    out = {
         "per_bundle": merged,
         "dead_replicas": dead,
         "failed_request_ids": lost,
     }
+    if lost_requests:
+        out["lost_requests"] = lost_requests
+    return out
 
 
 def _regrow_block(bundles, notes):

@@ -72,6 +72,7 @@ import importlib.util
 import json
 import os
 import sys
+import tempfile
 import time
 
 REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
@@ -108,6 +109,7 @@ def _run_traffic_trace(engine, shape, *, steps, vocab, max_new, rng,
     traffic, and an AutoScaler that must grow into the spike.  Returns
     the artifact's ``trace`` row."""
     import tempfile
+    from bluefog_tpu.diagnostics import SLOEngine
     from bluefog_tpu.run.launcher import _read_scale
     from bluefog_tpu.serve import Scheduler
     from bluefog_tpu.serve.scheduler import AutoScaler
@@ -125,6 +127,11 @@ def _run_traffic_trace(engine, shape, *, steps, vocab, max_new, rng,
         queue_high=engine.scfg.slots,       # breach when one replica's
         cooldown_steps=3,                   # worth of slots is waiting
         scale_file=scale_file, min_replicas=1)
+    # the SLO engine scores the same phase: burn-rate gauges every step,
+    # fast-burn tripwire when the spike torches the error budget
+    slo = SLOEngine(p99_ms=scaler.slo_p99_s * 1000.0)
+    sched.attach_slo(slo)
+    burn_peak = None
     arrivals = _trace_arrivals(shape, steps, engine.scfg.slots, rng)
     submitted = 0
     grow_step = None
@@ -132,8 +139,11 @@ def _run_traffic_trace(engine, shape, *, steps, vocab, max_new, rng,
     t = 0
 
     def _tick():
-        nonlocal grow_step, recovered_step
+        nonlocal grow_step, recovered_step, burn_peak
         sched.step()
+        rate = slo.last_burn.get(("5m", "p99"))
+        if rate is not None and (burn_peak is None or rate > burn_peak):
+            burn_peak = rate
         ev = scaler.observe()
         if ev and ev["action"] == "grow" and grow_step is None:
             grow_step = t
@@ -176,6 +186,14 @@ def _run_traffic_trace(engine, shape, *, steps, vocab, max_new, rng,
         "recovery_bound_steps": bound,
         "slo_p99_s": scaler.slo_p99_s,
         "ewma_p99_s": scaler.ewma_p99,
+        "slo": {
+            "burn_peak_5m_p99": (round(burn_peak, 3)
+                                 if burn_peak is not None else None),
+            "burn_final": {f"{w}/{s}": (round(v, 3) if v is not None
+                                        else None)
+                           for (w, s), v in sorted(slo.last_burn.items())},
+            "tripwires": sorted({f["kind"] for f in slo.fired}),
+        },
         "scale_events": scaler.events,
         "scale_file_target": scale_target,
         "ranks_per_replica": engine.m.slice_size,
@@ -390,6 +408,14 @@ def main():
                                    WeightRefresher)
     from bluefog_tpu.serve.engine import _parse_buckets
     from bluefog_tpu.utils import metrics as bfm
+    from bluefog_tpu.utils import tracing as _tracing
+
+    # arm request tracing before any scheduler exists so every request in
+    # the drain gets a span tree; the bundle feeds the latency-breakdown
+    # block at the end (BLUEFOG_TRACE wins if the operator set it)
+    trace_dir = os.environ.get(_tracing.ENV_TRACE) or tempfile.mkdtemp(
+        prefix="bftrace_")
+    _tracing.configure(trace_dir)
 
     devs = jax.devices()
     slice_sz = args.pp * args.tp
@@ -643,6 +669,28 @@ def main():
                 iters=3 if smoke else 20),
         }
 
+    # -- per-request latency breakdown from the tracer ----------------------
+    breakdown_doc = None
+    bundle = _tracing.flush()
+    if bundle:
+        tr = _load_tool("tools/trace_report")
+        tr_doc, _ = tr.report_from_files([bundle])
+        reqs_tr = tr_doc["requests"]
+        if reqs_tr:
+            def _mean(key):
+                return round(sum(v[key] for v in reqs_tr.values())
+                             / len(reqs_tr), 6)
+            breakdown_doc = {
+                "n_requests": len(reqs_tr),
+                "queue_mean_s": _mean("queue_s"),
+                "prefill_mean_s": _mean("prefill_s"),
+                "decode_mean_s": _mean("decode_s"),
+                "gap_mean_s": _mean("gap_s"),
+                "slowest": [[t, round(total, 6)] for t, total, *_ in
+                            tr_doc["critical_path"][:5]],
+                "bundle": bundle,
+            }
+
     doc = {
         "schema": SCHEMA,
         "ok": True,
@@ -693,6 +741,7 @@ def main():
         "kv": kv_doc,
         "decode": decode_doc,
         "trace": trace_doc,
+        "latency_breakdown": breakdown_doc,
         "invariants": {
             "donation_intact": bool(cache_probe.is_deleted()),
             "retraces_after_warmup": retraces,

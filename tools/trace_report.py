@@ -1,0 +1,242 @@
+"""Merge per-rank trace bundles into Chrome-trace + critical-path report.
+
+Each rank armed with ``BLUEFOG_TRACE=<dir>`` writes
+``<dir>/trace_rank<r>.trace.jsonl`` (schema ``bluefog-trace-1``: one
+``meta`` line carrying a ``(monotonic, wall)`` clock anchor, then one
+line per span — see ``bluefog_tpu/utils/tracing.py``).  This tool is the
+job-level view:
+
+* **merge** — every bundle's spans on one wall-clock axis (span
+  endpoints are per-rank ``time.monotonic()``; the meta anchor converts
+  them: ``wall = meta.wall + (t - meta.mono)``),
+* **--chrome** — a ``chrome://tracing`` / Perfetto file (``traceEvents``
+  with ``ph: "X"`` complete events, one process per rank, one thread
+  lane per trace id),
+* **critical path** — per-request breakdown from the ``cat="serve"``
+  span tree: queue wait vs prefill vs summed fused-decode time vs the
+  scheduling gap (host time between calls).  The root ``request`` span's
+  endpoints are the scheduler's own ``submitted_at``/``finished_at``
+  stamps, so ``total_s`` IS the request's measured E2E latency and
+  ``queue + prefill + decode + gap == total`` by construction.
+
+Run: python tools/trace_report.py <bundle.trace.jsonl> ... [--dir DIR]
+     [--out report.json] [--chrome trace.json]
+
+Output schema (stable, pinned by tests/test_tracing.py):
+    {"ok": bool, "schema": "bluefog-trace-report-1",
+     "n_ranks": int, "ranks": [...], "n_spans": int, "dropped": int,
+     "requests": {trace_id: {"total_s", "queue_s", "prefill_s",
+                             "decode_s", "gap_s", "n_decode_calls",
+                             "tokens", "replica", "prefix_hit",
+                             "spec_accepted"}},
+     "critical_path": [[trace_id, total_s, queue_s, prefill_s, decode_s,
+                        gap_s], ...]   # slowest first
+     "train": {"steps": int, "step_mean_s": float|None,
+               "probes": int}}
+"""
+import argparse
+import glob
+import json
+import os
+import sys
+
+SCHEMA = "bluefog-trace-report-1"
+BUNDLE_SCHEMA = "bluefog-trace-1"
+
+
+def load_bundle(path, notes=None):
+    """One bundle -> (meta, [spans]).  Torn trailing lines (the writer
+    died mid-append) are skipped with a warning, never fatal."""
+    meta, spans = None, []
+    with open(path) as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                doc = json.loads(line)
+            except json.JSONDecodeError as e:
+                msg = (f"warning: {path}:{lineno}: skipping torn JSONL "
+                       f"line ({e.msg})")
+                print(msg, file=sys.stderr)
+                if notes is not None:
+                    notes.append(msg)
+                continue
+            if doc.get("kind") == "meta":
+                meta = doc
+            elif doc.get("kind") == "span":
+                spans.append(doc)
+    if meta is None:
+        raise ValueError(f"{path}: no meta line (not a {BUNDLE_SCHEMA} "
+                         "bundle?)")
+    if meta.get("schema") != BUNDLE_SCHEMA:
+        raise ValueError(f"{path}: schema {meta.get('schema')!r} != "
+                         f"{BUNDLE_SCHEMA!r}")
+    return meta, spans
+
+
+def _wall(meta, t):
+    """Per-rank monotonic timestamp -> shared wall-clock seconds."""
+    return meta["wall"] + (t - meta["mono"])
+
+
+ATTR_SKIP = {"kind", "seq", "trace", "span", "name", "t0", "t1", "cat",
+             "parent"}
+
+
+def chrome_trace(bundles):
+    """``[(meta, spans)]`` -> Chrome-trace dict (``traceEvents``).
+
+    One pid per rank, one tid lane per trace id within the rank; ts/dur
+    in microseconds relative to the earliest span across all ranks.
+    """
+    t_min = None
+    for meta, spans in bundles:
+        for s in spans:
+            w = _wall(meta, s["t0"])
+            t_min = w if t_min is None or w < t_min else t_min
+    events = []
+    for meta, spans in bundles:
+        rank = meta.get("rank", 0)
+        events.append({"ph": "M", "pid": rank, "tid": 0,
+                       "name": "process_name",
+                       "args": {"name": f"rank{rank}"}})
+        lanes = {}
+        for s in spans:
+            trace = s.get("trace", "")
+            tid = lanes.get(trace)
+            if tid is None:
+                tid = lanes[trace] = len(lanes) + 1
+                events.append({"ph": "M", "pid": rank, "tid": tid,
+                               "name": "thread_name",
+                               "args": {"name": trace}})
+            w0 = _wall(meta, s["t0"])
+            dur = max(s["t1"] - s["t0"], 0.0)
+            events.append({
+                "ph": "X", "pid": rank, "tid": tid,
+                "name": s.get("name", "?"), "cat": s.get("cat") or "span",
+                "ts": round((w0 - (t_min or 0.0)) * 1e6, 3),
+                "dur": round(dur * 1e6, 3),
+                "args": {k: v for k, v in s.items() if k not in ATTR_SKIP},
+            })
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def critical_path(bundles):
+    """Per-request breakdown from the serve span trees.
+
+    Only requests with a root ``request`` span (i.e. retired) get a row.
+    ``gap_s`` is everything the named child spans don't cover: host-side
+    scheduling between the fused calls.
+    """
+    reqs = {}
+    for meta, spans in bundles:
+        for s in spans:
+            if s.get("cat") != "serve":
+                continue
+            acc = reqs.setdefault(s["trace"], {
+                "queue_s": 0.0, "prefill_s": 0.0, "decode_s": 0.0,
+                "n_decode_calls": 0, "spec_accepted": 0,
+                "prefix_hit": None, "total_s": None})
+            name = s.get("name")
+            dur = max(s["t1"] - s["t0"], 0.0)
+            if name == "queue":
+                acc["queue_s"] += dur
+            elif name == "prefill":
+                acc["prefill_s"] += dur
+                acc["prefix_hit"] = bool(s.get("hit"))
+            elif name == "decode":
+                acc["decode_s"] += dur
+                acc["n_decode_calls"] += 1
+                acc["spec_accepted"] += int(s.get("accepted", 0))
+            elif name == "request":
+                acc["total_s"] = dur
+                acc["tokens"] = s.get("tokens")
+                acc["replica"] = s.get("replica")
+    out = {}
+    for trace, acc in reqs.items():
+        if acc["total_s"] is None:
+            continue                          # still in flight at flush
+        acc["gap_s"] = max(acc["total_s"] - acc["queue_s"]
+                           - acc["prefill_s"] - acc["decode_s"], 0.0)
+        out[trace] = {k: (round(v, 9) if isinstance(v, float) else v)
+                      for k, v in acc.items()}
+    return out
+
+
+def train_summary(bundles):
+    steps, probes, total = 0, 0, 0.0
+    for meta, spans in bundles:
+        for s in spans:
+            if s.get("cat") != "train":
+                continue
+            if s.get("name") == "train_step":
+                steps += 1
+                total += max(s["t1"] - s["t0"], 0.0)
+            elif s.get("name") == "consensus_probe":
+                probes += 1
+    return {"steps": steps,
+            "step_mean_s": round(total / steps, 9) if steps else None,
+            "probes": probes}
+
+
+def report_from_files(paths):
+    notes = []
+    bundles = [load_bundle(p, notes=notes) for p in paths]
+    reqs = critical_path(bundles)
+    table = sorted(
+        ([t, v["total_s"], v["queue_s"], v["prefill_s"], v["decode_s"],
+          v["gap_s"]] for t, v in reqs.items()),
+        key=lambda row: -row[1])
+    doc = {
+        "ok": True,
+        "schema": SCHEMA,
+        "n_ranks": len(bundles),
+        "ranks": sorted(m.get("rank", 0) for m, _ in bundles),
+        "n_spans": sum(len(s) for _, s in bundles),
+        "dropped": sum(m.get("dropped", 0) for m, _ in bundles),
+        "requests": reqs,
+        "critical_path": table,
+        "train": train_summary(bundles),
+    }
+    if notes:
+        doc["notes"] = notes
+    return doc, bundles
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("bundles", nargs="*",
+                    help="per-rank *.trace.jsonl bundles")
+    ap.add_argument("--dir", default=None,
+                    help="glob <dir>/*.trace.jsonl in addition to bundles")
+    ap.add_argument("--out", default=None, help="write the report JSON here")
+    ap.add_argument("--chrome", default=None,
+                    help="write a chrome://tracing file here")
+    args = ap.parse_args()
+    paths = list(args.bundles)
+    if args.dir:
+        paths += sorted(glob.glob(os.path.join(args.dir, "*.trace.jsonl")))
+    if not paths:
+        print(json.dumps({"ok": False, "error": "no bundles given"}))
+        sys.exit(1)
+    try:
+        doc, bundles = report_from_files(paths)
+    except (OSError, ValueError) as e:
+        doc = {"ok": False, "error": f"{type(e).__name__}: {e}"[:300]}
+        bundles = None
+    if args.chrome and bundles is not None:
+        os.makedirs(os.path.dirname(args.chrome) or ".", exist_ok=True)
+        with open(args.chrome, "w") as f:
+            json.dump(chrome_trace(bundles), f)
+        doc["chrome"] = args.chrome
+    print(json.dumps(doc))
+    if args.out:
+        os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+        with open(args.out, "w") as f:
+            json.dump(doc, f, indent=1)
+    sys.exit(0 if doc.get("ok") else 1)
+
+
+if __name__ == "__main__":
+    main()

@@ -11,7 +11,7 @@ PY ?= python
 	autotune-smoke elastic-smoke lm-smoke moe-smoke moe-fast-smoke \
 	serve-smoke \
 	serve-fast-smoke flash-decode-smoke \
-	async-smoke regrow-smoke
+	async-smoke regrow-smoke preempt-smoke
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -344,6 +344,32 @@ regrow-smoke:
 		assert any(e['action'] == 'grow' for e in t['scale_events']), t; \
 		assert d['invariants']['retraces_after_warmup'] == 0, d; \
 		print('regrow-smoke OK')"
+
+# preemptible-fleet smoke: the preempt pytest battery (chaos preempt kind,
+# trace grammar, launcher drain, warm executable pool, staleness
+# controller, repeated-abort atomicity) plus the mass-preemption goodput
+# drill — trace generated fresh, replayed through preempt_bench with its
+# three gates (goodput floor, float64 continuity, zero-fresh-compile warm
+# regrowth), and the flight bundle must yield a "preempted" blame
+preempt-smoke:
+	$(PY) -m pytest tests/test_preempt.py -q -m "not slow"
+	rm -rf /tmp/preempt_flight
+	$(PY) tools/preempt_trace.py --pattern mass --world 4 --zones 2 \
+		--duration 8 --grace 1 --regrant 3 \
+		--out /tmp/preempt_trace_mass.json
+	$(PY) tools/preempt_bench.py --trace /tmp/preempt_trace_mass.json \
+		--virtual-cpu 4 --flight-dir /tmp/preempt_flight
+	$(PY) tools/postmortem.py --dir /tmp/preempt_flight \
+		--out /tmp/postmortem_preempt.json
+	$(PY) -c "import json; \
+		d = json.load(open('/tmp/postmortem_preempt.json')); \
+		v = d['verdict']; \
+		assert v['failure_kind'] == 'preempted', v; \
+		p = d['preempt']; \
+		assert p['victims'] and p['zones'], p; \
+		assert p['warm_restores'] >= 1, p; \
+		print('preempt drill postmortem OK'); \
+		print('preempt-smoke OK')"
 
 # resilience smoke: deterministic fault injection + healing/rollback on
 # the virtual CPU mesh (kill->heal->contract, NaN->rollback, restart

@@ -896,6 +896,101 @@ def async_window_gossip(
     return DecentralizedOptimizer(init, update, (axis,))
 
 
+class AdaptiveStalenessController:
+    """Learn the async staleness bound K online from fleet pace signals.
+
+    The bound is a trace-time constant of :func:`async_window_gossip` —
+    ``K=0`` even compiles a different (statically lockstep) program — so
+    "online" here is host-side: the controller watches the same per-rank
+    step-time table the AutoScaler and straggler detector read
+    (:func:`bluefog_tpu.diagnostics.observe_step_time` /
+    ``last_step_times``), recommends the bound that absorbs the current
+    pace spread, and after ``patience`` consecutive agreeing observations
+    applies it via :func:`bluefog_tpu.parallel.context.set_async_gossip` +
+    ``mark_steady_state(False)`` (the retrace that follows is intended, not
+    a bug).  The caller rebuilds its step on a non-``None`` return; with
+    the warm executable pool a return to a previously-seen K costs no
+    fresh compile.
+
+    The recommendation: a rank running at ``r×`` the alive-median pace
+    needs its neighbors to tolerate ``ceil(r) - 1`` missed ticks before a
+    forced sync-up, so ``K = clamp(ceil(max_alive / median) - 1, k_min,
+    k_max)``.  A throttled spot rank therefore deepens the window and
+    degrades gracefully; when its pace recovers K shrinks back toward
+    lockstep.  Hysteresis: a change is applied only after the same
+    recommendation holds ``patience`` observations in a row, so a single
+    noisy step cannot thrash the compiled program.
+    """
+
+    def __init__(self, *, k_min: int = 0, k_max: int = 16,
+                 patience: int = 3, dead_ranks: Sequence[int] = ()):
+        if not (0 <= k_min <= k_max):
+            raise ValueError(
+                f"need 0 <= k_min <= k_max, got {k_min}..{k_max}")
+        if patience < 1:
+            raise ValueError(f"patience must be >= 1, got {patience}")
+        self.k_min = int(k_min)
+        self.k_max = int(k_max)
+        self.patience = int(patience)
+        self.dead_ranks = frozenset(int(r) for r in dead_ranks)
+        self._candidate: Optional[int] = None
+        self._streak = 0
+        self.applied: Optional[int] = None
+
+    @property
+    def current_bound(self) -> int:
+        return _mesh.async_gossip_bound()
+
+    def recommend(self, step_times: Optional[Sequence[float]] = None
+                  ) -> Optional[int]:
+        """The bound the current pace spread calls for (no side effects).
+        ``None`` when no step-time table has been observed yet."""
+        from . import diagnostics as _diag
+        t = (np.asarray(step_times, np.float64).reshape(-1)
+             if step_times is not None else _diag.last_step_times())
+        if t is None or np.size(t) == 0:
+            return None
+        t = np.asarray(t, np.float64).reshape(-1)
+        alive = [r for r in range(t.size)
+                 if r not in self.dead_ranks and np.isfinite(t[r])]
+        if not alive:
+            return None
+        med = float(np.median(t[alive]))
+        if med <= 0:
+            return None
+        spread = float(np.max(t[alive])) / med
+        k = int(np.ceil(spread)) - 1
+        return max(self.k_min, min(self.k_max, k))
+
+    def observe(self, step_times: Optional[Sequence[float]] = None
+                ) -> Optional[int]:
+        """Fold one pace observation in; returns the newly-applied bound
+        when the hysteresis window agrees on a change, else ``None`` (the
+        caller rebuilds its optimizer/step only on a non-``None`` return).
+        """
+        rec = self.recommend(step_times)
+        if rec is None or rec == self.current_bound:
+            self._candidate, self._streak = None, 0
+            return None
+        if rec == self._candidate:
+            self._streak += 1
+        else:
+            self._candidate, self._streak = rec, 1
+        if self._streak < self.patience:
+            return None
+        old = self.current_bound
+        self._candidate, self._streak = None, 0
+        _mesh.set_async_gossip(rec)
+        _metrics.mark_steady_state(False)   # the K-change retrace is intended
+        self.applied = rec
+        _metrics.gauge(
+            "bluefog_async_staleness_bound",
+            "async gossip staleness bound K (pace-adaptive)").set(rec)
+        _flight.record("async_bound", old=old, new=rec,
+                       reason="pace_adaptive")
+        return rec
+
+
 def push_diging(
     opt: optax.GradientTransformation,
     sched: Optional[CommSchedule] = None,

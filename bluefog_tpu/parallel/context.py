@@ -340,7 +340,11 @@ def reinit(world_size: int, *,
 
     from ..utils import metrics as _metrics
     from ..utils import flight as _flight
-    clear_program_cache()       # every cached executable names the old mesh
+    from . import exec_cache as _exec
+    # every cached executable names the old mesh — but a later regrow back
+    # to this shape should not pay recompilation: park, then clear
+    _exec.stash(ctx, old_compose)
+    clear_program_cache()
     _metrics.mark_steady_state(False)
 
     devs = np.asarray(devs_list, dtype=object)
@@ -370,6 +374,10 @@ def reinit(world_size: int, *,
             num_experts=old_compose.num_experts,
             capacity_factor=old_compose.capacity_factor,
             devices=devs_list, wire=old_compose.wire)
+
+    # warm re-entry: a previously-seen world shape restores its parked
+    # programs — the regrow recompiles nothing (preempt_bench pins this)
+    _exec.restore(new_ctx, _active_compose)
 
     # the old world's membership registry (and its pristine baseline) is
     # meaningless against the new mesh — re-baseline from scratch
@@ -402,10 +410,15 @@ def _install(ctx: BlueFogTpuContext, compose=None) -> None:
     """Reinstall a previously captured context (the regrow rollback path:
     a failed :func:`reinit` must leave the process on the old world)."""
     global _context, _active_compose
+    from . import exec_cache as _exec
+    if _context is not None:
+        # park the aborted world's programs too: its shape may come back
+        _exec.stash(_context, _active_compose)
     clear_program_cache()
     with _lock:
         _context = ctx
         _active_compose = compose
+    _exec.restore(ctx, compose)
     # in a real multi-process job _rebootstrap_distributed mutated this
     # to the aborted target; a later launch/reinit must see the world
     # actually installed (the single-process sim never mutates it)
@@ -495,6 +508,8 @@ def shutdown() -> None:
     _chaos.uninstall()
     _chaos._corrupt_programs.clear()  # jitted corruptors pin device buffers
     clear_program_cache()     # executables pin device buffers past shutdown
+    from . import exec_cache as _exec
+    _exec.clear()             # ... and so does the warm pool
     with _lock:
         _context = None
 

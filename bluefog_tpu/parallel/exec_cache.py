@@ -1,0 +1,256 @@
+"""Warm executable pool: recompile-free regrowth across world changes.
+
+``reinit``/``regrow_world`` drop the process program cache because every
+cached executable names the old mesh — correct, but at pod scale the
+regrowth critical path is then dominated by recompilation.  This module
+makes the drop a *stash*: before the cache is cleared, the live program
+dict is parked under a **world key** describing the shape it was compiled
+for, and when a later reinit lands on a previously-seen shape the parked
+programs are restored wholesale.  Restored entries are ordinary cache
+entries — the program-cache keys embed everything an executable depends on
+(op, schedule, mesh, shape, dtype, donation: see ``context._program_cache``),
+so an entry stashed under one world shape and restored into an identical
+one is hit via the exact same key, and an entry whose key no longer matches
+is simply never hit.  The pool therefore needs no invalidation logic for
+correctness, only for memory.
+
+The world key buckets on ``(device kind, world size, nodes_per_machine,
+carving, async staleness, dcn wire, round-parallel)`` — the knobs that
+change program *structure*.  Strategy-level knobs (fused_k, wire overrides)
+are already inside each program-cache key.
+
+A best-effort **disk layer** (``BLUEFOG_EXEC_CACHE=<dir>``) additionally
+AOT-serializes compiled executables (``jax.stages.Compiled`` entries, e.g.
+from ``cached_lowering``) so a fresh process can warm-start.  Not every
+backend supports executable deserialization — the documented failure mode
+is ``DeserializeLoadedExecutable not supported`` — so the layer is gated by
+a one-shot :func:`serialization_supported` probe that warns and falls back
+to compile instead of raising mid-regrow.  ``BLUEFOG_EXEC_CACHE=off``
+disables the pool entirely (every regrow recompiles, the pre-pool
+behavior); unset keeps the in-process pool with no disk persistence.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+import threading
+import warnings
+from typing import Dict, Optional, Tuple
+
+ENV_VAR = "BLUEFOG_EXEC_CACHE"
+_OFF_VALUES = ("off", "0", "false", "no", "none")
+
+_lock = threading.Lock()
+_pool: Dict[tuple, dict] = {}
+_stats = {"stashes": 0, "restores": 0, "entries_restored": 0,
+          "disk_saved": 0, "disk_loaded": 0}
+_serialize_probe: Optional[bool] = None
+
+
+def enabled() -> bool:
+    """False only under ``BLUEFOG_EXEC_CACHE=off`` (and friends): unset
+    keeps the in-memory pool, a directory value adds the disk layer."""
+    return os.environ.get(ENV_VAR, "").strip().lower() not in _OFF_VALUES
+
+
+def cache_dir() -> Optional[str]:
+    """The disk-layer directory, or None (in-memory pool only)."""
+    val = os.environ.get(ENV_VAR, "").strip()
+    if not val or val.lower() in _OFF_VALUES:
+        return None
+    return os.path.abspath(val)
+
+
+def world_key(ctx=None, compose=None) -> tuple:
+    """The world-shape bucket a program dict belongs to.
+
+    Only a bucketing key: program-cache keys embed the mesh and every other
+    dependency, so a wrong bucket can cost a recompile but never a wrong
+    executable.
+    """
+    if ctx is None:
+        from . import context as _mesh
+        ctx = _mesh.get_context()
+        if compose is None:
+            compose = _mesh.get_compose()
+    dev0 = ctx.devices[0] if len(ctx.devices) else None
+    carving = None
+    if compose is not None:
+        carving = tuple(int(getattr(compose, ax, 0) or 0)
+                        for ax in ("dp", "pp", "tp", "sp", "ep"))
+    return ("bfexec-1",
+            getattr(dev0, "device_kind", getattr(dev0, "platform", None)),
+            int(ctx.size), int(ctx.nodes_per_machine), carving,
+            ctx.async_staleness, ctx.dcn_wire, ctx.round_parallel)
+
+
+def stats() -> dict:
+    with _lock:
+        return dict(_stats)
+
+
+def pool_size() -> int:
+    with _lock:
+        return len(_pool)
+
+
+def clear() -> None:
+    """Drop every stashed program dict (executables pin device buffers —
+    shutdown must not leave them alive behind the pool)."""
+    with _lock:
+        _pool.clear()
+
+
+def serialization_supported() -> bool:
+    """One-shot probe for AOT executable (de)serialization.
+
+    Some backends compile fine but cannot round-trip a serialized
+    executable (``DeserializeLoadedExecutable not supported``); probing at
+    the first disk-layer touch — instead of discovering it mid-regrow —
+    turns that into a single warning and an in-memory-only pool.
+    """
+    global _serialize_probe
+    if _serialize_probe is not None:
+        return _serialize_probe
+    try:
+        import jax
+        import jax.numpy as jnp
+        from jax.experimental import serialize_executable as _se
+
+        compiled = jax.jit(lambda x: x + 1).lower(
+            jnp.zeros((), jnp.float32)).compile()
+        payload, in_tree, out_tree = _se.serialize(compiled)
+        _se.deserialize_and_load(payload, in_tree, out_tree)
+        _serialize_probe = True
+    except Exception as e:                        # noqa: BLE001
+        warnings.warn(
+            f"executable serialization unsupported on this backend "
+            f"({type(e).__name__}: {e}); BLUEFOG_EXEC_CACHE keeps the "
+            f"in-memory warm pool but skips the disk layer",
+            RuntimeWarning, stacklevel=2)
+        _serialize_probe = False
+    return _serialize_probe
+
+
+def _entry_path(root: str, wkey: tuple, entry_key) -> Optional[str]:
+    try:
+        blob = pickle.dumps((wkey, entry_key))
+    except Exception:             # mesh/device objects: in-memory only
+        return None
+    return os.path.join(root, hashlib.sha1(blob).hexdigest() + ".bfexec")
+
+
+def _disk_save(wkey: tuple, entries: dict) -> None:
+    root = cache_dir()
+    if root is None or not serialization_supported():
+        return
+    import jax
+    from jax.experimental import serialize_executable as _se
+
+    for entry_key, fn in entries.items():
+        if not isinstance(fn, jax.stages.Compiled):
+            continue              # jit wrappers are not AOT-serializable
+        path = _entry_path(root, wkey, entry_key)
+        if path is None or os.path.exists(path):
+            continue
+        try:
+            payload, in_tree, out_tree = _se.serialize(fn)
+            os.makedirs(root, exist_ok=True)
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as fh:
+                pickle.dump({"key": (wkey, entry_key), "payload": payload,
+                             "in_tree_out_tree": (in_tree, out_tree)}, fh)
+            os.replace(tmp, path)
+            with _lock:
+                _stats["disk_saved"] += 1
+        except Exception:                         # noqa: BLE001
+            continue              # best effort: a cold compile, not a fault
+
+
+def _disk_load(wkey: tuple) -> dict:
+    root = cache_dir()
+    if root is None or not os.path.isdir(root):
+        return {}
+    if not serialization_supported():
+        return {}
+    from jax.experimental import serialize_executable as _se
+
+    out: dict = {}
+    try:
+        names = [n for n in os.listdir(root) if n.endswith(".bfexec")]
+    except OSError:
+        return {}
+    for name in names:
+        try:
+            with open(os.path.join(root, name), "rb") as fh:
+                doc = pickle.load(fh)
+            saved_wkey, entry_key = doc["key"]
+            if saved_wkey != wkey:
+                continue
+            in_tree, out_tree = doc["in_tree_out_tree"]
+            out[entry_key] = _se.deserialize_and_load(
+                doc["payload"], in_tree, out_tree)
+            with _lock:
+                _stats["disk_loaded"] += 1
+        except Exception:                         # noqa: BLE001
+            continue              # stale/foreign entry: fall back to compile
+    return out
+
+
+def stash(ctx=None, compose=None) -> int:
+    """Park the live program cache under its world key (called just before
+    the cache is cleared for a world change).  Returns the entry count."""
+    if not enabled():
+        return 0
+    from . import context as _mesh
+    try:
+        wkey = world_key(ctx, compose)
+    except Exception:                             # noqa: BLE001
+        return 0
+    with _mesh._lock:
+        entries = dict(_mesh._program_cache)
+    if not entries:
+        return 0
+    with _lock:
+        bucket = _pool.setdefault(wkey, {})
+        bucket.update(entries)
+        _stats["stashes"] += 1
+    _disk_save(wkey, entries)
+    return len(entries)
+
+
+def restore(ctx=None, compose=None) -> int:
+    """Refill the program cache from the pool for the (new) world shape.
+
+    Restored entries are later *hits*: ``program_cache_stats()["misses"]``
+    stays flat across a warm regrow — the compile-counter invariant
+    ``tools/preempt_bench.py`` pins.  Returns the number restored.
+    """
+    if not enabled():
+        return 0
+    from . import context as _mesh
+    try:
+        wkey = world_key(ctx, compose)
+    except Exception:                             # noqa: BLE001
+        return 0
+    with _lock:
+        entries = dict(_pool.get(wkey, ()))
+    disk = _disk_load(wkey)
+    for k, v in disk.items():
+        entries.setdefault(k, v)
+    if not entries:
+        return 0
+    with _mesh._lock:
+        for k, v in entries.items():
+            _mesh._program_cache.setdefault(k, v)
+    with _lock:
+        _stats["restores"] += 1
+        _stats["entries_restored"] += len(entries)
+    try:
+        from ..utils import flight as _flight
+        _flight.record("exec_cache", name="restore", world=wkey[2],
+                       entries=len(entries), disk_entries=len(disk))
+    except Exception:                             # pragma: no cover
+        pass
+    return len(entries)

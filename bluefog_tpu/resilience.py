@@ -928,14 +928,21 @@ class _PhaseRunner:
 
 
 def _snapshot_registry() -> Dict[str, Any]:
+    # the pristine baseline is copied (its keys are rebound in place by
+    # _refresh_pristine/_apply_membership) so an aborted regrow that
+    # touched it cannot poison the rollback capsule
     with _lock:
         return {"dead": set(_dead), "retired": set(_retired),
                 "draining": set(_draining),
                 "warmup": {r: list(v) for r, v in _warmup.items()},
-                "pristine": _pristine}
+                "pristine": dict(_pristine) if _pristine is not None
+                else None}
 
 
 def _restore_registry(snap: Dict[str, Any]) -> None:
+    # every value is re-copied out of the snapshot so the snapshot itself
+    # stays pristine: a second abort restoring from the same capsule gets
+    # exactly the same state as the first
     global _pristine
     with _lock:
         _dead.clear()
@@ -946,7 +953,8 @@ def _restore_registry(snap: Dict[str, Any]) -> None:
         _draining.update(snap["draining"])
         _warmup.clear()
         _warmup.update({r: list(v) for r, v in snap["warmup"].items()})
-        _pristine = snap["pristine"]
+        _pristine = (dict(snap["pristine"])
+                     if snap["pristine"] is not None else None)
 
 
 def _host_snapshot(tree: Any):
@@ -995,6 +1003,31 @@ def _carry_state(snap, old_n: int, new_n: int, new_ctx) -> Any:
         else:
             out.append(jax.device_put(arr, rep_sharding))
     return jax.tree.unflatten(treedef, out)
+
+
+def _abort_rollback(capsule: Dict[str, Any], attempts: int = 3) -> None:
+    """Reinstall the retained old world from the abort capsule.
+
+    The rollback window is itself preemptible: a second spot reclaim (or
+    any async exception) can land between re-installing the old context
+    and restoring the membership registry, splitting the pair.  Each
+    attempt therefore re-runs BOTH halves from the capsule — which no
+    restore ever mutates — so a retry after a mid-rollback failure
+    converges on exactly the pre-regrow world instead of a hybrid.
+    """
+    last: Optional[Exception] = None
+    for attempt in range(1, attempts + 1):
+        try:
+            _mesh._install(capsule["ctx"], capsule["compose"])
+            _restore_registry(capsule["registry"])
+            return
+        except Exception as exc:     # second preemption mid-rollback
+            last = exc
+            _flight.record("regrow", name="rollback_retry", attempt=attempt,
+                           error=f"{type(exc).__name__}: {exc}")
+    raise RuntimeError(
+        f"regrow rollback failed {attempts} times; the retained world "
+        "may be inconsistent") from last
 
 
 def regrow_world(target: int, params: Any = None, *,
@@ -1147,14 +1180,17 @@ def regrow_world(target: int, params: Any = None, *,
     except Exception as exc:
         status["aborts"] += 1
         rank = getattr(exc, "rank", None)
-        _mesh._install(capsule["ctx"], capsule["compose"])
-        _restore_registry(capsule["registry"])
-        _publish_regrow(status)
-        _flight.record("regrow", name="abort", phase=runner.phase,
-                       world_before=old_n, world_after=target,
-                       coordinator=coordinator, rank=rank,
-                       error=f"{type(exc).__name__}: {exc}")
-        _fault_span(f"resilience:regrow_abort:{runner.phase}")
+        try:
+            _abort_rollback(capsule)
+        finally:
+            # bookkeeping runs even if the rollback itself blew up, so
+            # the abort is never invisible to the flight recorder
+            _publish_regrow(status)
+            _flight.record("regrow", name="abort", phase=runner.phase,
+                           world_before=old_n, world_after=target,
+                           coordinator=coordinator, rank=rank,
+                           error=f"{type(exc).__name__}: {exc}")
+            _fault_span(f"resilience:regrow_abort:{runner.phase}")
         raise RegrowAborted(
             runner.phase, f"{type(exc).__name__}: {exc}",
             rank=rank) from exc

@@ -132,6 +132,20 @@ def build_parser() -> argparse.ArgumentParser:
                         "supervisor and `bfrun-tpu --scale N` (default: "
                         "<flight-dir>/bluefog_scale, else a per-user file "
                         "under the system temp dir)")
+    p.add_argument("--preempt-trace", default=None,
+                   help="replay a spot-preemption trace (JSON, schema "
+                        "bluefog-preempt-trace-1; generate with "
+                        "tools/preempt_trace.py) against the local ranks: "
+                        "at each event the victims get SIGTERM advance "
+                        "notice, the grace window to drain (flush flight + "
+                        "trace bundles), then SIGKILL; after the re-grant "
+                        "delay the reclaimed capacity respawns as "
+                        "fresh-identity joins.  Requires -np")
+    p.add_argument("--preempt-grace", type=float, default=None,
+                   help="default advance-notice seconds for preemption "
+                        "events that do not carry their own grace=; also "
+                        "exported to children as BLUEFOG_PREEMPT_GRACE so "
+                        "in-process drain logic knows its budget")
     p.add_argument("--no-xla-tuning", action="store_true",
                    help="do not add the recommended TPU overlap XLA flags")
     p.add_argument("--serve", action="store_true",
@@ -222,6 +236,8 @@ def _child_env(args) -> dict:
         env["BLUEFOG_PREFIX_PAGES"] = args.prefix_pages
     if args.refresh_every is not None:
         env["BLUEFOG_REFRESH_EVERY"] = str(args.refresh_every)
+    if args.preempt_grace is not None:
+        env["BLUEFOG_PREEMPT_GRACE"] = str(args.preempt_grace)
     if not args.no_xla_tuning:
         from ..utils.config import (
             RECOMMENDED_TPU_XLA_FLAGS, looks_like_tpu_environment)
@@ -456,10 +472,51 @@ def _report_flight_bundles(flight_dir, say) -> None:
         say(f"no flight bundles found in {flight_dir}")
 
 
+PREEMPT_TRACE_SCHEMA = "bluefog-preempt-trace-1"
+
+
+def _load_preempt_trace(path: str, *, default_grace=None) -> dict:
+    """Parse a ``bluefog-preempt-trace-1`` JSON file into a normalized
+    ``{"zones": Z, "world": N|None, "events": [...]}`` dict.  Each event
+    carries ``t`` (seconds after supervision start), victims (an explicit
+    rank list or a ``zone`` id), ``grace`` advance-notice seconds, and the
+    ``regrant`` delay before the reclaimed capacity comes back."""
+    import json
+
+    with open(path, "r", encoding="utf-8") as fh:
+        doc = json.load(fh)
+    if doc.get("schema") != PREEMPT_TRACE_SCHEMA:
+        raise SystemExit(
+            f"--preempt-trace {path}: expected schema "
+            f"{PREEMPT_TRACE_SCHEMA!r}, got {doc.get('schema')!r}")
+    events = []
+    for ev in doc.get("events", ()):
+        grace = ev.get("grace", doc.get("grace"))
+        if grace is None:
+            grace = 0.0 if default_grace is None else default_grace
+        events.append({
+            "t": float(ev["t"]),
+            "zone": ev.get("zone"),
+            "victims": [int(r) for r in ev.get("victims", ())],
+            "grace": max(0.0, float(grace)),
+            "regrant": max(0.0, float(ev.get("regrant",
+                                            doc.get("regrant", 0.0)))),
+        })
+        if not events[-1]["victims"] and events[-1]["zone"] is None:
+            raise SystemExit(
+                f"--preempt-trace {path}: event at t={ev['t']} names "
+                "neither victims nor a zone")
+    events.sort(key=lambda e: e["t"])
+    return {"zones": max(1, int(doc.get("zones", 1))),
+            "world": doc.get("world"), "pattern": doc.get("pattern"),
+            "events": events}
+
+
 def _supervise_procs(procs, respawn=None, *, restart_limit=0,
                      restart_backoff=1.0, labels=None,
                      poll_interval=0.2, flight_dir=None,
-                     elastic=False, scale_file=None, spawn=None) -> int:
+                     elastic=False, scale_file=None, spawn=None,
+                     preempt_trace=None) -> int:
     """Supervise one Popen per rank; the shared exit path for ``-np`` and
     ``-H`` launches.
 
@@ -499,13 +556,79 @@ def _supervise_procs(procs, respawn=None, *, restart_limit=0,
     restarts = [0] * len(procs)
     done = [False] * len(procs)
     retiring: set = set()
+    preempted: set = set()
     joins = 0
     applied_target: Optional[int] = None
+    world0 = len(procs)
+    trace = preempt_trace or {"zones": 1, "world": None, "events": []}
+    trace_world = int(trace.get("world") or world0)
+    pending = list(trace["events"])      # sorted by t at load time
+    notified: list = []                  # grace windows awaiting hard kill
+    regrants: list = []                  # reclaimed capacity awaiting return
+    t0 = _time.monotonic()
 
     def say(msg):
         print(f"bfrun-tpu: {msg}", file=sys.stderr, flush=True)
 
+    def _preempt_victims(ev):
+        if ev["victims"]:
+            ranks = ev["victims"]
+        else:
+            from ..utils.chaos import zone_victims
+            ranks = zone_victims(ev["zone"], trace_world, trace["zones"])
+        return [r for r in ranks
+                if r < len(procs) and not done[r] and r not in retiring]
+
     while True:
+        now = _time.monotonic() - t0
+        # -- preemption-trace replay: notice -> grace -> kill -> re-grant --
+        while pending and pending[0]["t"] <= now:
+            ev = pending.pop(0)
+            victims = _preempt_victims(ev)
+            if not victims:
+                continue
+            zone = (f"zone {ev['zone']} " if ev["zone"] is not None else "")
+            say(f"preempt: {zone}reclaiming rank(s) {victims} "
+                f"(grace {ev['grace']:g} s, re-grant {ev['regrant']:g} s)")
+            for r in victims:
+                retiring.add(r)
+                preempted.add(r)
+                _count_membership("preempt")
+                if procs[r].poll() is None:
+                    try:        # the SIGTERM advance notice: drain window
+                        procs[r].send_signal(_signal.SIGTERM)
+                    except OSError:                   # pragma: no cover
+                        pass
+            notified.append({"ranks": victims, "kill_at": now + ev["grace"],
+                             "regrant": ev["regrant"]})
+        for notice in list(notified):
+            if now < notice["kill_at"]:
+                continue
+            notified.remove(notice)
+            for r in notice["ranks"]:       # grace expired: the reclaim lands
+                if not done[r] and procs[r].poll() is None:
+                    say(f"preempt: grace expired, killing {labels[r]}")
+                    try:
+                        procs[r].kill()
+                    except OSError:                   # pragma: no cover
+                        pass
+            if spawn is not None:
+                regrants.append({"count": len(notice["ranks"]),
+                                 "at": now + notice["regrant"]})
+        for grant in list(regrants):
+            if now < grant["at"]:
+                continue
+            regrants.remove(grant)
+            for _ in range(grant["count"]):
+                rank = len(procs)
+                joins += 1
+                say(f"preempt re-grant: starting rank {rank} "
+                    f"(fresh identity, join {joins})")
+                procs.append(spawn(rank, trace_world, joins))
+                labels.append(f"rank {rank}")
+                restarts.append(0)
+                done.append(False)
+                _count_membership("join")
         if elastic and scale_file and spawn is not None:
             target = _read_scale(scale_file, min_world=1)
             if target is not None and target != applied_target:
@@ -548,7 +671,8 @@ def _supervise_procs(procs, respawn=None, *, restart_limit=0,
             if rank in retiring:
                 # asked to leave: any exit (incl. -SIGTERM) is a clean retire
                 done[rank] = True
-                say(f"{labels[rank]} retired (exit code {code})")
+                verb = "preempted" if rank in preempted else "retired"
+                say(f"{labels[rank]} {verb} (exit code {code})")
                 continue
             if code == 0:
                 done[rank] = True
@@ -873,6 +997,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         scale_file = _scale_file_path(args, env) if args.elastic else None
         if args.elastic and args.scale is not None:
             _write_scale(scale_file, args.scale)
+        trace = (_load_preempt_trace(args.preempt_trace,
+                                     default_grace=args.preempt_grace)
+                 if args.preempt_trace else None)
         procs = _spawn_local_workers(n, coordinator, env, cmd)
         return _supervise_procs(
             procs,
@@ -883,7 +1010,12 @@ def main(argv: Optional[List[str]] = None) -> int:
             flight_dir=env.get("BLUEFOG_FLIGHT_DIR"),
             elastic=args.elastic, scale_file=scale_file,
             spawn=lambda rank, total, joins: _spawn_local_worker(
-                rank, total, coordinator, env, cmd, join_count=joins))
+                rank, total, coordinator, env, cmd, join_count=joins),
+            preempt_trace=trace)
+
+    if args.preempt_trace:
+        raise SystemExit("--preempt-trace requires -np (the local "
+                         "supervisor replays the trace against its ranks)")
 
     if args.coordinator:
         _apply_coordinator_env(args, env)

@@ -212,6 +212,24 @@ class Scheduler:
             raise RuntimeError("every serving replica has failed")
         return lost
 
+    def preempt_replica(self, replica: int, *, zone: Optional[int] = None,
+                        grace: float = 0.0) -> List[Request]:
+        """Evict a replica whose backing ranks were spot-preempted.
+
+        Same mechanics as a chaos kill — the slice is reclaimed, so
+        ``park=False``: in-flight requests requeue at the head and the
+        prefix directory is rebuilt empty on a later
+        :meth:`restore_replica` — but the flight event says *preempted*
+        (with the zone and grace window) so postmortems blame the reclaim,
+        not a crash.  When the capacity is re-granted, bring the replica
+        back with :meth:`restore_replica`.
+        """
+        lost = self.fail_replica(replica, reason="preempted", park=False)
+        _flight.record("serve", name="replica_preempt_notice",
+                       replica=replica, zone=zone, grace=float(grace),
+                       requeued=len(lost))
+        return lost
+
     def restore_replica(self, replica: int) -> bool:
         """Bring a previously-failed replica back into rotation.
         Returns True if the replica was dead.

@@ -41,6 +41,20 @@ Fault kinds (reference failure modes they emulate):
   against the per-phase *attempt* counter via :func:`on_regrow_phase`;
   the expected outcome is a clean abort that leaves the old world
   training/serving.
+- ``preempt``  — a spot/preemptible reclaim with real spot semantics:
+  ``grace=`` seconds of advance notice before the hard kill (a
+  ``preempt_notice`` flight event fires immediately; the launcher's
+  trace replay turns it into SIGTERM + a grace window before SIGKILL),
+  ``zone=`` correlated victims (zone z of the plan-level ``zones=Z``
+  split owns the contiguous rank block ``[z*n/Z, (z+1)*n/Z)`` — a
+  reclaim takes the whole zone down together, like a real availability
+  zone), and ``regrant=`` seconds the capacity stays reclaimed before
+  the provider re-grants it.  Raises :class:`RankPreempted` (a
+  :class:`RankKilled` subclass, default exit code 143 = SIGTERM) so
+  every existing kill path — launcher supervision, regrow no-retry —
+  handles it, while postmortems blame "preempted", not "killed".
+  ``preempt:step=4,zone=1,grace=2,regrant=30`` with ``zones=2``
+  preempts the upper half of the fleet at step 4.
 
 Matching sites: faults with ``op=``/``call=`` match eager op dispatches
 (``api.py`` / ``parallel/windows.py``); all others match the train-step
@@ -65,18 +79,21 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
 __all__ = [
-    "Fault", "ChaosPlan", "RankKilled",
+    "Fault", "ChaosPlan", "RankKilled", "RankPreempted",
     "install", "uninstall", "active", "current_plan",
     "maybe_install_from_env", "on_train_step", "corrupt_train_output",
     "apply_membership", "on_eager_op", "on_regrow_phase",
-    "consume_step_delays",
+    "consume_step_delays", "zone_victims",
 ]
 
 ENV_VAR = "BLUEFOG_CHAOS"
 DEFAULT_KILL_CODE = 43
+#: 128 + SIGTERM: what a spot victim's exit status reads after the grace
+#: window — supervisors distinguish a reclaim from a crash by this code
+DEFAULT_PREEMPT_CODE = 143
 
 _KINDS = ("kill", "hang", "throttle", "nan", "join",
-          "kill_coordinator", "kill_joiner", "hang_reinit")
+          "kill_coordinator", "kill_joiner", "hang_reinit", "preempt")
 
 #: Fault kinds that fire inside the mesh-regrowth protocol (matched by
 #: :func:`on_regrow_phase` against the per-phase attempt counter, never by
@@ -114,6 +131,50 @@ class RankKilled(RuntimeError):
             f"step {step} (exit code {code})")
 
 
+def zone_victims(zone: int, size: int, zones: int) -> Tuple[int, ...]:
+    """Ranks a zone-correlated preemption reclaims together.
+
+    Zone ``z`` of ``zones`` owns the contiguous block
+    ``[z*size/zones, (z+1)*size/zones)`` — contiguous because a real zone
+    is a physical slice/datacenter block, and the hierarchical machine
+    grouping keeps each slice's chips contiguous on the rank axis.
+    """
+    z, zn = int(zone), max(1, int(zones))
+    if not (0 <= z < zn):
+        raise ValueError(f"zone {z} out of range for zones={zn}")
+    return tuple(range(z * size // zn, (z + 1) * size // zn))
+
+
+class RankPreempted(RankKilled):
+    """A chaos ``preempt`` fault fired: the victim ranks lost their spot
+    capacity.  ``ranks`` is the full correlated victim set (one rank for a
+    ``rank=`` fault, a whole contiguous zone block for ``zone=``);
+    ``grace`` is the advance-notice window in seconds the victims got to
+    drain, ``regrant`` how long the capacity stays reclaimed before the
+    provider re-grants it.  Training loops catch this and shrink via
+    :func:`bluefog_tpu.resilience.regrow_world`, then regrow when the
+    re-grant lands — the warm executable pool makes the round trip
+    recompile-free.
+    """
+
+    def __init__(self, ranks: Tuple[int, ...], step: int, *,
+                 zone: Optional[int] = None, grace: float = 0.0,
+                 regrant: float = 0.0, code: int = DEFAULT_PREEMPT_CODE):
+        self.ranks = tuple(int(r) for r in ranks)
+        self.zone = zone
+        self.grace = float(grace)
+        self.regrant = float(regrant)
+        first = self.ranks[0] if self.ranks else None
+        RuntimeError.__init__(
+            self,
+            f"chaos: rank(s) {list(self.ranks)} preempted at step {step}"
+            + (f" (zone {zone})" if zone is not None else "")
+            + f" with {self.grace:g} s grace (exit code {code})")
+        self.rank = first
+        self.step = step
+        self.code = code
+
+
 @dataclass(frozen=True)
 class Fault:
     """One fault clause.  ``step`` doubles as the throttle window start."""
@@ -127,6 +188,9 @@ class Fault:
     p: Optional[float] = None        # seeded per-step probability
     code: int = DEFAULT_KILL_CODE    # kill exit code
     warmup: int = 0                  # join entry-weight ramp steps
+    zone: Optional[int] = None       # preempt: correlated-victim zone id
+    grace: float = 0.0               # preempt: advance-notice seconds
+    regrant: float = 0.0             # preempt: capacity re-grant delay (s)
 
     def __post_init__(self):
         if self.kind not in _KINDS:
@@ -137,6 +201,23 @@ class Fault:
             raise ValueError(f"{self.kind} fault needs t=<seconds> > 0")
         if self.kind in ("nan", "join") and self.rank is None:
             raise ValueError(f"{self.kind} fault needs rank=<target rank>")
+        if self.kind == "preempt":
+            if self.rank is None and self.zone is None:
+                raise ValueError(
+                    "preempt fault needs rank=<victim> or zone=<zone id>")
+            if self.rank is not None and self.zone is not None:
+                raise ValueError(
+                    "preempt fault takes rank= OR zone=, not both")
+            if self.grace < 0 or self.regrant < 0:
+                raise ValueError("preempt grace/regrant must be >= 0")
+            if self.op is not None or self.call is not None:
+                raise ValueError(
+                    "preempt faults match train steps, not eager ops "
+                    "(no op=/call=)")
+        elif self.zone is not None or self.grace or self.regrant:
+            raise ValueError(
+                f"zone=/grace=/regrant= only apply to preempt faults, "
+                f"not {self.kind}")
         if self.kind == "join" and (self.op is not None
                                     or self.call is not None):
             raise ValueError(
@@ -163,20 +244,28 @@ class Fault:
 class ChaosPlan:
     """A seeded, immutable fault list plus the mutable match counters."""
 
-    def __init__(self, faults: List[Fault], seed: int = 0):
+    def __init__(self, faults: List[Fault], seed: int = 0, zones: int = 1):
         self.faults: Tuple[Fault, ...] = tuple(faults)
         self.seed = int(seed)
+        self.zones = max(1, int(zones))
+        for f in self.faults:
+            if f.kind == "preempt" and f.zone is not None:
+                if not (0 <= f.zone < self.zones):
+                    raise ValueError(
+                        f"preempt zone {f.zone} out of range for plan-level "
+                        f"zones={self.zones} (add a 'zones=Z' clause)")
         self._op_calls: Dict[str, int] = {}
         self._lock = threading.Lock()
 
     # -- parsing ----------------------------------------------------------
-    _INT_KEYS = ("step", "until", "call", "rank", "code", "warmup")
-    _FLOAT_KEYS = ("t", "p")
+    _INT_KEYS = ("step", "until", "call", "rank", "code", "warmup", "zone")
+    _FLOAT_KEYS = ("t", "p", "grace", "regrant")
 
     @classmethod
     def parse(cls, spec: str) -> "ChaosPlan":
         """Parse the ``BLUEFOG_CHAOS`` grammar (see module docstring)."""
         seed = 0
+        zones = 1
         faults: List[Fault] = []
         for clause in spec.split(";"):
             clause = clause.strip()
@@ -184,11 +273,14 @@ class ChaosPlan:
                 continue
             if ":" not in clause:
                 key, _, val = clause.partition("=")
-                if key.strip() != "seed" or not val:
+                if key.strip() not in ("seed", "zones") or not val:
                     raise ValueError(
-                        f"bad chaos clause {clause!r}: expected 'seed=N' or "
-                        "'kind:key=value,...'")
-                seed = int(val)
+                        f"bad chaos clause {clause!r}: expected 'seed=N', "
+                        "'zones=Z', or 'kind:key=value,...'")
+                if key.strip() == "seed":
+                    seed = int(val)
+                else:
+                    zones = int(val)
                 continue
             kind, _, body = clause.partition(":")
             kw: dict = {}
@@ -214,7 +306,7 @@ class ChaosPlan:
                     raise ValueError(
                         f"unknown chaos parameter {key!r} in {clause!r}")
             faults.append(Fault(kind=kind.strip(), **kw))
-        return cls(faults, seed=seed)
+        return cls(faults, seed=seed, zones=zones)
 
     # -- matching ---------------------------------------------------------
     def _draw(self, fault_index: int, fault: Fault, tick: int) -> bool:
@@ -331,11 +423,12 @@ def maybe_install_from_env() -> bool:
 
 def _record_fault(fault: Fault, site: str, dur_s: float = 0.0,
                   tick: Optional[int] = None,
-                  rank: Optional[int] = None) -> None:
+                  rank: Optional[int] = None, **extra) -> None:
     try:
         from . import flight as _flight
         _flight.record("chaos", name=f"{fault.kind}:{site}", step=tick,
-                       rank=fault.rank if rank is None else rank, t=fault.t)
+                       rank=fault.rank if rank is None else rank, t=fault.t,
+                       **extra)
     except Exception:                                      # pragma: no cover
         pass
     try:
@@ -354,6 +447,27 @@ def _record_fault(fault: Fault, site: str, dur_s: float = 0.0,
     now_us = _tl._now_us()
     _tl.record_span(f"chaos:{site}", "FAULT",
                     now_us - dur_s * 1e6, max(dur_s * 1e6, 1.0))
+
+
+def _world_size() -> int:
+    """Fleet size a zone maps onto: the launcher's process count in a
+    multi-process job, else the live context's rank count in the
+    single-process SPMD simulation (1 before init)."""
+    try:
+        n = int(os.environ.get("BLUEFOG_NUM_PROCESSES", "0"))
+    except ValueError:                                     # pragma: no cover
+        n = 0
+    if n > 1:
+        return n
+    import sys as _sys
+    if "jax" in _sys.modules:
+        try:
+            from ..parallel import context as _mesh
+            if _mesh.is_initialized():
+                return _mesh.get_context().size
+        except Exception:                                  # pragma: no cover
+            pass
+    return max(1, n)
 
 
 def _ambient_rank() -> Optional[int]:
@@ -408,6 +522,33 @@ def _enact(fault: Fault, site: str, tick: int) -> None:
     if fault.kind == "kill":
         _record_fault(fault, site, tick=tick)
         raise RankKilled(fault.rank, tick, fault.code)
+    if fault.kind == "preempt":
+        if fault.rank is not None:
+            victims: Tuple[int, ...] = (fault.rank,)
+        else:
+            plan = _plan
+            victims = zone_victims(fault.zone or 0, _world_size(),
+                                   plan.zones if plan is not None else 1)
+        if me is not None and me not in victims:
+            return
+        # advance notice first: a spot victim gets to flush telemetry
+        # inside the grace window before the reclaim lands
+        try:
+            from . import flight as _flight
+            _flight.record("preempt_notice", step=tick, zone=fault.zone,
+                           grace=fault.grace, regrant=fault.regrant,
+                           victims=list(victims))
+        except Exception:                                  # pragma: no cover
+            pass
+        _record_fault(fault, site, tick=tick,
+                      rank=me if me is not None else fault.rank,
+                      zone=fault.zone, grace=fault.grace,
+                      regrant=fault.regrant, victims=list(victims))
+        code = (fault.code if fault.code != DEFAULT_KILL_CODE
+                else DEFAULT_PREEMPT_CODE)
+        raise RankPreempted(victims, tick, zone=fault.zone,
+                            grace=fault.grace, regrant=fault.regrant,
+                            code=code)
     if fault.kind in ("hang", "throttle"):
         _record_fault(fault, site, dur_s=fault.t, tick=tick)
         time.sleep(fault.t)

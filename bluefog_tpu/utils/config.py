@@ -63,6 +63,35 @@ def apply_recommended_xla_flags() -> bool:
     return True
 
 
+_deserialize_probe: Optional[bool] = None
+
+
+def compilation_cache_supported() -> Optional[bool]:
+    """One-shot probe: can this backend round-trip a serialized executable?
+
+    The documented failure mode is ``DeserializeLoadedExecutable not
+    supported`` — a backend that compiles fine but throws on every cache
+    *load*, which surfaces mid-regrow as a hard error instead of the warm
+    start it was meant to be.  Probing once up front turns that into a
+    warning and a compile fallback.  Returns ``None`` (unknown) when the
+    backend is not yet initialized: the probe compiles a trivial program,
+    and initializing the backend as a side effect would violate the same
+    contract :func:`enable_compilation_cache` keeps.
+    """
+    global _deserialize_probe
+    if _deserialize_probe is not None:
+        return _deserialize_probe
+    try:
+        from jax._src import xla_bridge as _xb
+        if not _xb.backends_are_initialized():
+            return None
+        from ..parallel import exec_cache as _exec
+        _deserialize_probe = bool(_exec.serialization_supported())
+    except Exception:                      # noqa: BLE001 — old jax: assume ok
+        _deserialize_probe = True
+    return _deserialize_probe
+
+
 def enable_compilation_cache(path: Optional[str] = None) -> Optional[str]:
     """Enable JAX's persistent compilation cache (idempotent).
 
@@ -92,6 +121,13 @@ def enable_compilation_cache(path: Optional[str] = None) -> Optional[str]:
         platforms = (jax.config.jax_platforms or "").strip()
         if platforms.split(",")[0].strip() == "cpu":
             return None                    # CPU-pinned: see docstring
+        if compilation_cache_supported() is False:
+            logger.warning(
+                "persistent compilation cache disabled: this backend "
+                "cannot deserialize cached executables "
+                "(DeserializeLoadedExecutable not supported) — every "
+                "program falls back to a fresh compile")
+            return None
         os.makedirs(path, exist_ok=True)
         # cache everything that took a meaningful compile (the default 1 s
         # floor would skip small collective programs that still cost real

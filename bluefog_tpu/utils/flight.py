@@ -341,6 +341,14 @@ def _on_sigterm(signum, frame):
         dump(reason="sigterm")
     except Exception:                                     # pragma: no cover
         pass
+    # a SIGTERM is often a spot-preemption advance notice: the trace ring
+    # would otherwise only flush at atexit, which a follow-up SIGKILL skips
+    try:
+        from . import tracing as _tracing
+        if _tracing.enabled():
+            _tracing.flush()
+    except Exception:                                     # pragma: no cover
+        pass
     if callable(_prev_sigterm):
         _prev_sigterm(signum, frame)
         return

@@ -256,6 +256,81 @@ def test_hang_reinit_exhausts_deadline_and_rolls_back(world4, monkeypatch):
     _assert_old_world_alive(params)
 
 
+def test_back_to_back_aborts_leave_old_world_atomic(world4, monkeypatch):
+    """Two consecutive preemption-style aborts: each rollback must restore
+    EXACTLY the pre-regrow world — context, carving, and membership
+    registry — and the old world must keep stepping in between."""
+    params = _row_params(world4, 4)
+    rz.mark_rank_dead(3)
+    snap0 = rz._snapshot_registry()
+    monkeypatch.setattr(bfctx, "reinit", lambda *a, **k: (_ for _ in ()).throw(
+        RuntimeError("zone reclaimed mid-reinit")))
+    for _ in range(2):
+        with pytest.raises(rz.RegrowAborted) as ei:
+            rz.regrow_world(6, params, retries=1, backoff=0.001)
+        assert ei.value.phase == "reinit"
+        assert bf.get_context().size == 4
+        assert not rz.regrow_pending()
+        snap = rz._snapshot_registry()
+        assert snap["dead"] == snap0["dead"] == {3}
+        assert snap["retired"] == snap0["retired"]
+        assert snap["warmup"] == snap0["warmup"]
+        out = bf.neighbor_allreduce(params["w"])
+        jax.block_until_ready(out)
+    monkeypatch.undo()
+    # the hardened rollback does not poison a genuine regrow afterwards
+    _, handle = rz.regrow_world(6, params)
+    handle.commit()
+    assert bf.get_context().size == 6
+
+
+def test_second_failure_mid_rollback_still_converges(world4, monkeypatch):
+    """A second preemption landing DURING the rollback window (between
+    reinstalling the old context and restoring the registry) must not
+    split the pair: the rollback re-runs both halves from the capsule and
+    converges on the retained old world."""
+    params = _row_params(world4, 4)
+    flight.configure(4096)
+    monkeypatch.setattr(bfctx, "reinit", lambda *a, **k: (_ for _ in ()).throw(
+        RuntimeError("zone reclaimed mid-reinit")))
+    real_install = bfctx._install
+    calls = {"n": 0}
+
+    def flaky_install(ctx, compose):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("second spot reclaim mid-rollback")
+        return real_install(ctx, compose)
+
+    monkeypatch.setattr(bfctx, "_install", flaky_install)
+    with pytest.raises(rz.RegrowAborted):
+        rz.regrow_world(6, params, retries=1, backoff=0.001)
+    monkeypatch.undo()
+    assert calls["n"] == 2                  # the retry re-ran BOTH halves
+    _assert_old_world_alive(params)
+    retries_logged = [e for e in flight.events()
+                      if e.get("kind") == "regrow"
+                      and e.get("name") == "rollback_retry"]
+    assert len(retries_logged) == 1
+    # the abort is still visible to the flight recorder despite the bumpy
+    # rollback
+    assert any(e.get("name") == "abort" for e in flight.events()
+               if e.get("kind") == "regrow")
+
+
+def test_abort_capsule_registry_immune_to_restore_mutation(world4):
+    """The capsule snapshot is never mutated by a restore: mutating the
+    live registry between two restores must not leak back into the
+    snapshot (a second abort restores the same state as the first)."""
+    rz.mark_rank_dead(1)
+    snap = rz._snapshot_registry()
+    rz._restore_registry(snap)
+    rz.mark_rank_dead(2)                   # post-restore mutation
+    assert snap["dead"] == {1}             # snapshot unchanged
+    rz._restore_registry(snap)
+    assert rz.dead_ranks() == (1,)
+
+
 def test_regrow_chaos_kinds_reject_eager_site_matchers():
     with pytest.raises(ValueError):
         chaos.ChaosPlan.parse("kill_coordinator:step=1,op=neighbor_allreduce")

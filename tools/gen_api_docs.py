@@ -29,6 +29,8 @@ MODULES = [
     ("bluefog_tpu.ops.pallas_attention", "Pallas flash-attention kernels"),
     ("bluefog_tpu.ops.pallas_decode", "Paged flash-decode kernel (serving)"),
     ("bluefog_tpu.parallel.context", "Mesh context (init/topology state)"),
+    ("bluefog_tpu.parallel.exec_cache",
+     "Warm executable pool (recompile-free regrowth)"),
     ("bluefog_tpu.parallel.windows", "Window registry (named windows)"),
     ("bluefog_tpu.parallel.pipeline", "Pipeline parallelism"),
     ("bluefog_tpu.parallel.compose",

@@ -297,6 +297,28 @@ def _battery_steps(tag: str, stage: int = 0) -> list:
                    "--async-frontier",
                    "--out", os.path.join(m, f"async_frontier_{tag}.json")],
                   1200, None, None))
+    pb = os.path.join(REPO, "tools", "preempt_bench.py")
+    if os.path.exists(pb):
+        # the preemptible-fleet grader: a mass spot reclaim replayed
+        # against a virtual-CPU fleet.  The drill grades fleet semantics
+        # (goodput vs the ideal fleet, float64 trajectory continuity,
+        # zero-fresh-compile warm regrowth) rather than accelerator perf,
+        # so it pins jax to CPU itself and never dials the tunnel —
+        # cheap enough to run before the long multi-compile sweep
+        steps.append(("preempt_trace",
+                      [py, os.path.join(REPO, "tools", "preempt_trace.py"),
+                       "--pattern", "mass", "--world", "4", "--zones", "2",
+                       "--duration", "8", "--grace", "1", "--regrant", "3",
+                       "--out",
+                       os.path.join(m, f"preempt_trace_{tag}.json")],
+                      300, None, None))
+        steps.append(("preempt_bench",
+                      [py, pb, "--trace",
+                       os.path.join(m, f"preempt_trace_{tag}.json"),
+                       "--virtual-cpu", "4", "--flight-dir",
+                       os.path.join(m, f"preempt_flight_{tag}")],
+                      1200, os.path.join(m, f"preempt_bench_{tag}.json"),
+                      None))
     # 1,5,10 not 1,2,5,10: one fewer ResNet compile (~5 min of window)
     # and k=2 adds nothing the amortization curve needs
     steps.append(("step_sweep",
@@ -409,6 +431,18 @@ def _rehearsal_steps(tag: str) -> list:
           "--async-frontier", "--virtual-cpu", "--params", "2048",
           "--out", os.path.join(m, f"async_frontier_{tag}.json")], 600,
          None, None),
+        ("preempt_trace",
+         [py, os.path.join(REPO, "tools", "preempt_trace.py"),
+          "--pattern", "mass", "--world", "4", "--zones", "2",
+          "--duration", "8", "--grace", "1", "--regrant", "3",
+          "--out", os.path.join(m, f"preempt_trace_{tag}.json")], 120,
+         None, {"JAX_PLATFORMS": "cpu"}),
+        ("preempt_bench",
+         [py, os.path.join(REPO, "tools", "preempt_bench.py"),
+          "--trace", os.path.join(m, f"preempt_trace_{tag}.json"),
+          "--virtual-cpu", "4",
+          "--flight-dir", os.path.join(m, f"preempt_flight_{tag}")], 600,
+         os.path.join(m, f"preempt_bench_{tag}.json"), None),
         ("step_sweep",
          [py, os.path.join(REPO, "tools", "step_sweep.py"),
           "--sweep", "1,2", "--batch", "1", "--iters", "1", "--allow-cpu",

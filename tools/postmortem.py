@@ -89,6 +89,20 @@ def _failure_candidates(rank, bundle):
             prio = 0 if name in _HARD_KINDS else 1
             out.append((prio, ev.get("step"), ev.get("ts"),
                         name, ev.get("detail", ""), None))
+        elif kind == "chaos" and str(ev.get("name", "")).startswith("preempt"):
+            # a spot reclaim is not a crash: blame the rank as "preempted"
+            # with the zone/grace/victims the fault grammar recorded
+            victims = ev.get("victims") or [ev.get("rank")]
+            zone = ev.get("zone")
+            detail = ("spot preemption (rank(s) %s%s, %s s grace)"
+                      % (victims,
+                         f", zone {zone}" if zone is not None else "",
+                         ev.get("grace", 0)))
+            blame = ev.get("rank")
+            if blame is None and victims and victims[0] is not None:
+                blame = victims[0]        # zone fault: blame the first victim
+            out.append((0, ev.get("step"), ev.get("ts"), "preempted",
+                        detail, blame))
         elif kind == "chaos" and str(ev.get("name", "")).startswith("kill"):
             # the fault grammar records WHICH rank the kill targeted; carry
             # it so the verdict can blame that rank even when the event was
@@ -334,6 +348,48 @@ def _regrow_block(bundles, notes):
     return out
 
 
+def _preempt_block(bundles, notes):
+    """Surface spot-preemption events in the verdict timeline: zone, grace
+    window, victims, re-grant delay — from ``preempt_notice`` advance-notice
+    events, ``chaos`` ``preempt:*`` faults, and warm-pool ``exec_cache``
+    restores, merged and time-ordered.  Present only when a bundle saw a
+    preemption."""
+    timeline = []
+    for rank in sorted(bundles):
+        for ev in bundles[rank].get("events", ()):
+            kind = ev.get("kind")
+            is_preempt = (kind == "preempt_notice"
+                          or (kind == "chaos" and str(
+                              ev.get("name", "")).startswith("preempt"))
+                          or kind == "exec_cache")
+            if not is_preempt:
+                continue
+            entry = {k: v for k, v in ev.items() if v is not None}
+            entry["bundle_rank"] = rank
+            timeline.append(entry)
+    if not timeline:
+        return None
+    timeline.sort(key=lambda e: e.get("ts") or 0)
+    events = [e for e in timeline if e.get("kind") != "exec_cache"]
+    victims = sorted({int(r) for e in events
+                      for r in (e.get("victims") or ())})
+    zones = sorted({e["zone"] for e in events if e.get("zone") is not None})
+    restores = [e for e in timeline if e.get("kind") == "exec_cache"]
+    out = {
+        "timeline": timeline,
+        "events": len([e for e in events if e.get("kind") == "chaos"]),
+        "victims": victims,
+        "zones": zones,
+        "warm_restores": len(restores),
+    }
+    if victims:
+        notes.append(
+            "spot preemption reclaimed rank(s) %s%s — blamed as "
+            "\"preempted\", not a crash" % (
+                victims, f" (zone(s) {zones})" if zones else ""))
+    return out
+
+
 def analyze(bundles, notes=None, torn=()):
     """``{rank: bundle}`` -> postmortem report dict."""
     notes = notes if notes is not None else []
@@ -402,6 +458,9 @@ def analyze(bundles, notes=None, torn=()):
     regrow = _regrow_block(bundles, notes)
     if regrow is not None:
         report["regrow"] = regrow
+    preempt = _preempt_block(bundles, notes)
+    if preempt is not None:
+        report["preempt"] = preempt
     if notes:
         report["notes"] = notes
     return report

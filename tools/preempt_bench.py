@@ -1,0 +1,222 @@
+"""Preemptible-fleet goodput bench: replay a spot trace, grade the recovery.
+
+The ``make preempt-smoke`` centerpiece (schema ``bluefog-preempt-bench-1``):
+boots a virtual-CPU gossip fleet, replays a preemption trace
+(``bluefog-preempt-trace-1``, see ``tools/preempt_trace.py``) through the
+real in-process machinery — the chaos ``preempt`` fault fires through
+``on_train_step``, the shrink and the re-grant regrowth run the full
+:func:`bluefog_tpu.resilience.regrow_world` protocol — and grades:
+
+* **goodput fraction** — useful rank-steps achieved vs the ideal
+  never-preempted fleet over the same step count (outage windows run at
+  reduced width, scaled by each event's re-grant delay);
+* **optimizer-progress continuity** — params are float64 and every
+  preempt→regrow cycle asserts the survivors' rows cross the mesh
+  boundary bit-identical (zero lost optimizer progress);
+* **regrowth latency, cold vs warm** — the first cycle compiles, later
+  cycles re-enter previously-seen world shapes through the warm
+  executable pool (``parallel/exec_cache.py``);
+* **the compile-counter invariant** — a warm-cache regrow to a
+  previously-seen world shape performs ZERO fresh compiles
+  (``program_cache_stats()["misses"]`` stays flat across the regrow and
+  the steps after it).
+
+Prints a one-line JSON artifact on stdout (last line) and exits non-zero
+when any gate fails.  With ``--flight-dir`` the run dumps a flight bundle
+whose ``preempt`` chaos events ``tools/postmortem.py`` blames as
+"preempted" (zone, grace, victims) rather than "killed".
+
+Run::
+
+    python tools/preempt_trace.py --pattern mass --world 4 --zones 2 \
+        --duration 8 --regrant 3 --out /tmp/mass.json
+    python tools/preempt_bench.py --trace /tmp/mass.json --virtual-cpu 4 \
+        --flight-dir /tmp/preempt_flight
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, REPO)
+
+SCHEMA = "bluefog-preempt-bench-1"
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--trace", required=True,
+                    help="bluefog-preempt-trace-1 JSON file to replay")
+    ap.add_argument("--virtual-cpu", type=int, default=8,
+                    help="virtual CPU device pool (must cover the world)")
+    ap.add_argument("--world", type=int, default=None,
+                    help="fleet size (default: the trace's world)")
+    ap.add_argument("--steps-per-phase", type=int, default=2,
+                    help="gossip steps between trace phases")
+    ap.add_argument("--steps-per-second", type=float, default=1.0,
+                    help="how many outage steps one re-grant second costs")
+    ap.add_argument("--goodput-floor", type=float, default=0.5,
+                    help="fail the run below this goodput fraction")
+    ap.add_argument("--flight-dir", default=None,
+                    help="flight bundle directory for the postmortem")
+    args = ap.parse_args()
+
+    from bluefog_tpu.run.launcher import _load_preempt_trace
+    trace = _load_preempt_trace(args.trace)
+    world = int(args.world or trace.get("world") or 4)
+    if not trace["events"]:
+        raise SystemExit(f"--trace {args.trace}: no events to replay")
+    if args.virtual_cpu < world:
+        raise SystemExit(f"--virtual-cpu {args.virtual_cpu} cannot host "
+                         f"world {world}")
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count="
+            f"{args.virtual_cpu}").strip()
+    if args.flight_dir:
+        os.environ["BLUEFOG_FLIGHT_DIR"] = args.flight_dir
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)   # float64 trajectory oracle
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import bluefog_tpu as bf
+    from bluefog_tpu import resilience as rz
+    from bluefog_tpu.parallel import context as bfctx
+    from bluefog_tpu.parallel import exec_cache as bfexec
+    from bluefog_tpu.utils import chaos as bfchaos
+    from bluefog_tpu.utils import flight as bfflight
+    from bluefog_tpu.utils import metrics as bfm
+
+    bf.init(devices=jax.devices()[:world])
+    rng = np.random.default_rng(11)
+
+    def place(arr):
+        return jax.device_put(arr, NamedSharding(
+            bf.get_context().mesh, P("rank")))
+
+    params = {"w": place(rng.standard_normal((world, 16)))}
+    assert params["w"].dtype == np.float64
+
+    state = {"tick": 0, "achieved": 0, "ideal": 0}
+
+    def run_steps(k):
+        for _ in range(k):
+            params["w"] = bf.neighbor_allreduce(params["w"])
+            state["tick"] += 1
+            state["achieved"] += bf.get_context().size
+            state["ideal"] += world
+        jax.block_until_ready(params["w"])
+
+    def regrow(target):
+        t0 = time.perf_counter()
+        new_params, handle = rz.regrow_world(target, params)
+        dt = time.perf_counter() - t0
+        handle.commit()
+        return new_params, dt
+
+    def one_cycle(ev, label):
+        """Preempt -> shrink -> outage -> re-grant regrowth; returns the
+        per-cycle record."""
+        run_steps(args.steps_per_phase)
+        size_before = bf.get_context().size
+        # fire the fault through the real chaos path so the flight bundle
+        # carries the preempt event postmortem blames
+        if ev["zone"] is not None:
+            plan = (f"zones={trace['zones']};preempt:step={state['tick']+1},"
+                    f"zone={ev['zone']},grace={ev['grace']},"
+                    f"regrant={ev['regrant']}")
+        else:
+            plan = (f"preempt:step={state['tick']+1},rank={ev['victims'][0]},"
+                    f"grace={ev['grace']},regrant={ev['regrant']}")
+        bfchaos.install(plan)
+        victims = ()
+        try:
+            bfchaos.on_train_step(state["tick"] + 1)
+            raise SystemExit(f"preempt fault at tick {state['tick']+1} "
+                             "did not fire")
+        except bfchaos.RankPreempted as e:
+            victims = tuple(r for r in e.ranks if r < size_before)
+        finally:
+            bfchaos.uninstall()
+        state["tick"] += 1                    # the reclaimed step: no progress
+
+        target = max(1, size_before - len(victims))
+        pre = np.asarray(params["w"])
+        m_shrink0 = bfctx.program_cache_stats()["misses"]
+        new_params, shrink_s = regrow(target)
+        carried = np.asarray(new_params["w"])[:target]
+        shrink_lossless = bool(np.array_equal(carried, pre[:target]))
+        params.update(new_params)
+        shrink_compiles = (bfctx.program_cache_stats()["misses"] - m_shrink0)
+
+        # the outage window: reduced capacity until the re-grant lands
+        outage = max(1, int(round(ev["regrant"] * args.steps_per_second)))
+        run_steps(outage)
+
+        # re-grant: regrow back to the full fleet (a previously-seen shape)
+        pre2 = np.asarray(params["w"])
+        m0 = bfctx.program_cache_stats()["misses"]
+        new_params, regrow_s = regrow(world)
+        carried2 = np.asarray(new_params["w"])[:target]
+        regrow_lossless = bool(np.array_equal(carried2, pre2[:target]))
+        params.update(new_params)
+        run_steps(args.steps_per_phase)       # steps on the regrown world
+        fresh = bfctx.program_cache_stats()["misses"] - m0
+        return {
+            "label": label, "zone": ev["zone"], "victims": list(victims),
+            "grace": ev["grace"], "regrant": ev["regrant"],
+            "world_during_outage": target, "outage_steps": outage,
+            "shrink_s": round(shrink_s, 6), "regrow_s": round(regrow_s, 6),
+            "shrink_fresh_compiles": int(shrink_compiles),
+            "regrow_fresh_compiles": int(fresh),
+            "continuity_ok": bool(shrink_lossless and regrow_lossless),
+        }
+
+    cycles = [one_cycle(ev, f"event{i}")
+              for i, ev in enumerate(trace["events"])]
+    # always at least one warm cycle: replay the first event again so the
+    # compile-counter invariant is tested even on a single-event trace
+    cycles.append(one_cycle(trace["events"][0], "warm_verify"))
+    run_steps(args.steps_per_phase)
+
+    goodput = state["achieved"] / max(1, state["ideal"])
+    cold = cycles[0]
+    warm = cycles[1:]
+    warm_fresh = max(c["regrow_fresh_compiles"] for c in warm)
+    continuity = all(c["continuity_ok"] for c in cycles)
+    doc = {
+        "schema": SCHEMA, "ok": False, "trace": os.path.abspath(args.trace),
+        "pattern": trace.get("pattern"), "world": world,
+        "zones": trace["zones"], "events": len(trace["events"]),
+        "steps": state["tick"],
+        "achieved_rank_steps": state["achieved"],
+        "ideal_rank_steps": state["ideal"],
+        "goodput_fraction": round(goodput, 6),
+        "goodput_floor": args.goodput_floor,
+        "continuity_ok": continuity,
+        "cold_regrow_s": cold["regrow_s"],
+        "warm_regrow_s": round(min(c["regrow_s"] for c in warm), 6),
+        "warm_fresh_compiles": int(warm_fresh),
+        "preempt_events": len(cycles),
+        "victims_total": sum(len(c["victims"]) for c in cycles),
+        "faults_injected": int(
+            bfm.counter("bluefog_faults_injected_total").total()),
+        "exec_cache": bfexec.stats(),
+        "cycles": cycles,
+    }
+    doc["ok"] = bool(continuity and warm_fresh == 0
+                     and goodput >= args.goodput_floor)
+    if args.flight_dir:
+        doc["flight_bundle"] = bfflight.dump(reason="preempt_bench")
+    print(json.dumps(doc))
+    sys.exit(0 if doc["ok"] else 1)
+
+
+if __name__ == "__main__":
+    main()

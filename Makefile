@@ -10,7 +10,7 @@ PY ?= python
 	pod-smoke \
 	autotune-smoke elastic-smoke lm-smoke moe-smoke moe-fast-smoke \
 	serve-smoke \
-	serve-fast-smoke flash-decode-smoke \
+	serve-fast-smoke flash-decode-smoke moe-serve-smoke \
 	async-smoke regrow-smoke preempt-smoke
 
 test:
@@ -254,7 +254,7 @@ serve-smoke:
 		--out /tmp/serve_bench_smoke.json
 	$(PY) -c "import json; \
 		d = json.load(open('/tmp/serve_bench_smoke.json')); \
-		assert d['schema'] == 'bluefog-serve-bench-4' and d['ok'], d; \
+		assert d['schema'] == 'bluefog-serve-bench-5' and d['ok'], d; \
 		i = d['invariants']; \
 		assert i['donation_intact'] and \
 		i['retraces_after_warmup'] == 0, i; \
@@ -277,7 +277,7 @@ serve-fast-smoke:
 		--out /tmp/serve_bench_fast_smoke.json
 	$(PY) -c "import json; \
 		d = json.load(open('/tmp/serve_bench_fast_smoke.json')); \
-		assert d['schema'] == 'bluefog-serve-bench-4' and d['ok'], d; \
+		assert d['schema'] == 'bluefog-serve-bench-5' and d['ok'], d; \
 		s = d['spec']; \
 		assert s['bit_identical'] and s['drafted'] > 0, s; \
 		p = d['prefix']; \
@@ -301,7 +301,7 @@ flash-decode-smoke:
 		--out /tmp/serve_bench_flash_smoke.json
 	$(PY) -c "import json; \
 		d = json.load(open('/tmp/serve_bench_flash_smoke.json')); \
-		assert d['schema'] == 'bluefog-serve-bench-4' and d['ok'], d; \
+		assert d['schema'] == 'bluefog-serve-bench-5' and d['ok'], d; \
 		dec = d['decode']; \
 		assert dec['kernel'] == 'pallas' and dec['block_k'] == 8, dec; \
 		assert dec['bit_identical'], dec; \
@@ -311,6 +311,33 @@ flash-decode-smoke:
 		assert {r['kv_dtype'] for r in rows} == {'raw', 'int8'}, rows; \
 		assert d['invariants']['retraces_after_warmup'] == 0, d; \
 		print('flash-decode-smoke OK')"
+
+# MoE-serving smoke: the expert-parallel serving battery (decode-shaped
+# dropless tiles, small-tile Pallas-vs-XLA equality, the float64 MoE
+# decode oracle, spec-decode bit-identity, ep refresh, expert-load-aware
+# admission) plus serve_bench with the MoE estate armed — gated on the
+# schema-5 moe row: spec-vs-greedy token identity, a measured dense-twin
+# tokens/s at equal active params, and every dispatch/combine all_to_all
+# classified ICI (zero DCN a2a bytes per chip)
+moe-serve-smoke:
+	$(PY) -m pytest tests/test_serve_moe.py -q -m "not slow"
+	$(PY) tools/serve_bench.py --virtual-cpu --smoke \
+		--serve-moe 4x2@2:4 --spec-decode 2@1 \
+		--out /tmp/serve_bench_moe_smoke.json
+	$(PY) -c "import json; \
+		d = json.load(open('/tmp/serve_bench_moe_smoke.json')); \
+		assert d['schema'] == 'bluefog-serve-bench-5' and d['ok'], d; \
+		m = d['moe']; \
+		assert m['experts'] == 4 and m['ep'] == 2 and m['tile'] == 4, m; \
+		assert m['bit_identity']['bit_identical'], m; \
+		assert m['tokens_per_sec_moe'] > 0 and \
+		m['tokens_per_sec_dense_twin'] > 0, m; \
+		w = m['wire']; \
+		assert w['all_to_all_ici']['count'] >= 1 and \
+		w['all_to_all_dcn']['count'] == 0 and \
+		w['per_chip_dcn_bytes'] == 0, w; \
+		assert d['invariants']['retraces_after_warmup'] == 0, d; \
+		print('moe-serve-smoke OK')"
 
 # mesh-regrowth smoke: the regrow pytest battery (reinit, carry oracle,
 # chaos abort/rollback, autoscaler) plus the subprocess grow-by-2 drill —
@@ -336,7 +363,7 @@ regrow-smoke:
 		--traffic-trace flash-crowd --out /tmp/serve_bench_trace.json
 	$(PY) -c "import json; \
 		d = json.load(open('/tmp/serve_bench_trace.json')); \
-		assert d['schema'] == 'bluefog-serve-bench-4' and d['ok'], d; \
+		assert d['schema'] == 'bluefog-serve-bench-5' and d['ok'], d; \
 		t = d['trace']; \
 		assert t['ok'] and t['failed'] == 0, t; \
 		assert t['grow_step'] is not None and \

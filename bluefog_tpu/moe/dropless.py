@@ -35,7 +35,7 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-__all__ = ["dropless_rows", "tile_layout", "sort_by_expert",
+__all__ = ["dropless_rows", "tile_layout", "sort_by_expert", "decode_tile",
            "grouped_ffn", "grouped_ffn_xla"]
 
 
@@ -48,6 +48,31 @@ def dropless_rows(max_rows: int, num_groups: int, tile: int) -> int:
                          f"a positive static int, got {tile!r}")
     worst = max_rows + num_groups * (tile - 1)
     return ((worst + tile - 1) // tile) * tile
+
+
+def decode_tile(max_rows: int, num_groups: int, cap: int = 8) -> int:
+    """Decode-regime tile suggestion: the smallest power of two that
+    could hold an even split of ``max_rows`` rows over ``num_groups``
+    expert groups, capped at ``cap`` (default 8 — the f32 sublane tile;
+    larger tiles buy nothing at decode shapes and pad more).
+
+    At decode the row count is tiny (``T = lanes * top_k``, lanes <= 8),
+    so the training default ``tile=8`` makes every group's pad rows
+    dominate the real rows.  A tile of ``ceil(T / E_groups)`` rounded up
+    to a power of two keeps each tail tile majority-real in the balanced
+    case while staying sublane-friendly for the Pallas kernel (which
+    pads tiles < 8 up to the sublane minimum internally).
+    """
+    if max_rows < 1 or num_groups < 1 or cap < 1:
+        raise ValueError(
+            f"moe_dropless_invalid_tile: decode_tile needs positive "
+            f"max_rows/num_groups/cap, got ({max_rows}, {num_groups}, "
+            f"{cap})")
+    want = -(-max_rows // num_groups)
+    tile = 1
+    while tile < want and tile < cap:
+        tile *= 2
+    return min(tile, cap)
 
 
 def tile_layout(sizes: jax.Array, *, tile: int,

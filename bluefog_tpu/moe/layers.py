@@ -42,8 +42,8 @@ from ..parallel.expert import moe_apply_dropless, moe_combine, moe_dispatch
 from .dropless import grouped_ffn
 
 __all__ = ["router_topk", "router_expert_choice", "moe_ffn_routed",
-           "moe_ffn_dropless", "moe_ffn_expert_choice", "moe_ffn_dense",
-           "moe_ffn_dense_ec"]
+           "moe_ffn_dropless", "moe_dropless_combine",
+           "moe_ffn_expert_choice", "moe_ffn_dense", "moe_ffn_dense_ec"]
 
 
 def router_topk(x: jax.Array, wr: jax.Array, *, top_k: int):
@@ -190,9 +190,34 @@ def moe_ffn_dropless(
     by construction (``stats["dropped"]`` is exactly 0), no zero-padded
     slots matmul'd beyond the ≤ ``tile - 1`` pad rows per group.
     """
+    T = x.shape[0]
+    logits, probs, idx, gate = router_topk(x, wr, top_k=top_k)
+    y = moe_dropless_combine(x, idx, gate, w1, w2, num_experts=num_experts,
+                             axis=axis, tile=tile, impl=impl)
+    keep = jnp.ones((top_k * T,), dtype=bool)          # dropless by design
+    return y, _router_stats(logits, probs, idx, keep,
+                            num_experts=num_experts, axis=axis)
+
+
+def moe_dropless_combine(
+    x: jax.Array,                 # [T, D]
+    idx: jax.Array,               # [T, k] routed expert ids
+    gate: jax.Array,              # [T, k] renormalized gates
+    w1: jax.Array,                # [E_local, D, F/TP]
+    w2: jax.Array,                # [E_local, F/TP, D]
+    *,
+    num_experts: int,
+    axis: str = "expert",
+    tile: int = 8,
+    impl: str | None = None,
+) -> jax.Array:
+    """The gate-weighted dropless grouped-FFN on *precomputed* routing —
+    the math of :func:`moe_ffn_dropless` past the router.  Split out so
+    the serving hot path can route once and reuse ``(idx, gate)`` for
+    both the expert math and its hot-expert accounting without running
+    the router twice."""
     T, D = x.shape
-    E, k = num_experts, top_k
-    logits, probs, idx, gate = router_topk(x, wr, top_k=k)
+    E, k = num_experts, idx.shape[1]
     x_rep = jnp.tile(x, (k, 1))                        # [k*T, D]
     flat_idx = idx.T.reshape(k * T)                    # choice-major
 
@@ -206,10 +231,7 @@ def moe_ffn_dropless(
     out = moe_apply_dropless(x_rep, flat_idx, grouped, (w1, w2),
                              axis=axis, num_experts=E, tile=tile)
     gates = gate.T[..., None].astype(x.dtype)          # [k, T, 1]
-    y = jnp.sum(out.reshape(k, T, D) * gates, axis=0)
-    keep = jnp.ones((k * T,), dtype=bool)              # dropless by design
-    return y, _router_stats(logits, probs, idx, keep,
-                            num_experts=E, axis=axis)
+    return jnp.sum(out.reshape(k, T, D) * gates, axis=0)
 
 
 def router_expert_choice(x: jax.Array, wr: jax.Array, *, capacity: int):

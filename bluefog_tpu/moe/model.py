@@ -210,6 +210,17 @@ class MoELMConfig(LMConfig):
         return (6.0 * self.n_active_params
                 + 6.0 * self.layers * self.d_model * self.seq_len)
 
+    def dense_twin(self) -> LMConfig:
+        """The dense LM with the same *active* FFN parameters per token:
+        ``ffn_mult = top_k * ffn_mult`` and every skeleton field copied.
+        This is the fair serving baseline — tokens/s MoE vs dense at
+        equal active params (Switch-Transformer accounting), not vs the
+        E×-wider dense model nobody would deploy."""
+        fields = {f.name: getattr(self, f.name)
+                  for f in dataclasses.fields(LMConfig)}
+        fields["ffn_mult"] = self.top_k * self.ffn_mult
+        return LMConfig(**fields)
+
 
 def init_moe_params(cfg: MoELMConfig, m: Mesh3D, seed: int = 0,
                     dtype: Any = np.float32,

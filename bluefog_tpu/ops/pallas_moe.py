@@ -34,6 +34,14 @@ from jax.experimental.pallas import tpu as pltpu
 
 __all__ = ["grouped_ffn_pallas"]
 
+# f32 tiles are (8, 128) sublane x lane: a grouped block's second-to-minor
+# dim (the row tile) must be a multiple of 8.  Decode-regime tiles are
+# smaller (T = lanes * top_k is tiny), so _forward pads them up to the
+# sublane minimum and slices the pad rows back off — the pad rows are
+# zeros through both matmuls, never gathered, so this costs one VMEM-size
+# bump and no correctness.
+_MIN_SUBLANE = 8
+
 
 def _vma_of(x: jax.Array):
     # under shard_map the output varies over the same mesh axes as the input
@@ -52,6 +60,9 @@ def _grouped_kernel(eids_ref, x_ref, w1_ref, w2_ref, o_ref):
 
 def _forward(xt: jax.Array, tile_eid: jax.Array, w1: jax.Array,
              w2: jax.Array, interpret: bool) -> jax.Array:
+    G, real_tile, D = xt.shape
+    if real_tile < _MIN_SUBLANE:                       # decode-regime tiles
+        xt = jnp.pad(xt, ((0, 0), (0, _MIN_SUBLANE - real_tile), (0, 0)))
     G, tile, D = xt.shape
     _, _, F = w1.shape
     grid_spec = pltpu.PrefetchScalarGridSpec(
@@ -71,6 +82,8 @@ def _forward(xt: jax.Array, tile_eid: jax.Array, w1: jax.Array,
                                        vma=_vma_of(xt)),
         interpret=interpret,
     )(tile_eid.astype(jnp.int32), xt, w1, w2)
+    if real_tile < tile:
+        out = out[:, :real_tile]
     return out.astype(xt.dtype)
 
 

@@ -173,6 +173,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="serving weight refresh: pull fresh params from "
                         "the training fleet every N train steps (sets "
                         "BLUEFOG_REFRESH_EVERY; see serve.WeightRefresher)")
+    p.add_argument("--serve-moe", default=None,
+                   help="serve a routed MoE: "
+                        "'<experts>[x<top_k>][@<ep>][:<tile>]' e.g. "
+                        "'8x2@2:4' — experts, top-k routing, expert-"
+                        "parallel peers carved per replica, dropless "
+                        "decode tile (sets BLUEFOG_SERVE_MOE; see "
+                        "ServeConfig.from_env)")
     p.add_argument("--interactive", action="store_true",
                    help="drop into an initialized Python REPL instead of "
                         "running a command (reference: ibfrun). With -np N "
@@ -236,6 +243,8 @@ def _child_env(args) -> dict:
         env["BLUEFOG_PREFIX_PAGES"] = args.prefix_pages
     if args.refresh_every is not None:
         env["BLUEFOG_REFRESH_EVERY"] = str(args.refresh_every)
+    if args.serve_moe:
+        env["BLUEFOG_SERVE_MOE"] = args.serve_moe
     if args.preempt_grace is not None:
         env["BLUEFOG_PREEMPT_GRACE"] = str(args.preempt_grace)
     if not args.no_xla_tuning:

@@ -28,17 +28,29 @@ def main() -> int:
 
     pp = int(os.environ.get("BLUEFOG_SERVE_PP", "1"))
     tp = int(os.environ.get("BLUEFOG_SERVE_TP", "1"))
+    scfg = ServeConfig.from_env()
+    ep = scfg.moe_ep if scfg.moe_experts else 1
     devices = jax.devices()
-    slice_sz = pp * tp
+    slice_sz = pp * tp * ep
     if len(devices) % slice_sz:
         print(f"bluefog-serve: {len(devices)} devices do not carve into "
-              f"pp={pp} x tp={tp} slices", file=sys.stderr)
+              f"pp={pp} x tp={tp} x ep={ep} slices", file=sys.stderr)
         return 2
     dp = len(devices) // slice_sz
-    m = compose_parallelism(dp, pp, tp, 1, devices=devices)
-    cfg = LMConfig(layers=4 if 4 % pp == 0 else 2 * pp)
-    params = init_lm_params(cfg, m, seed=0)
-    engine = ServeEngine(m, cfg, params, ServeConfig.from_env())
+    layers = 4 if 4 % pp == 0 else 2 * pp
+    if scfg.moe_experts:
+        from ..moe.model import MoELMConfig, init_moe_params
+        m = compose_parallelism(dp, pp, tp, 1, ep, devices=devices,
+                                num_experts=scfg.moe_experts)
+        cfg = MoELMConfig(layers=layers, batch=ep,
+                          num_experts=scfg.moe_experts,
+                          top_k=scfg.moe_top_k, dispatch="dropless")
+        params = init_moe_params(cfg, m, seed=0)
+    else:
+        m = compose_parallelism(dp, pp, tp, 1, devices=devices)
+        cfg = LMConfig(layers=layers)
+        params = init_lm_params(cfg, m, seed=0)
+    engine = ServeEngine(m, cfg, params, scfg)
     engine.warmup()
     sched = Scheduler(engine)
     rng = np.random.default_rng(0)
@@ -50,7 +62,8 @@ def main() -> int:
     sched.drain()
     print(json.dumps({
         "schema": "bluefog-serve-demo-1",
-        "replicas": dp, "pp": pp, "tp": tp,
+        "replicas": dp, "pp": pp, "tp": tp, "ep": ep,
+        "moe_experts": scfg.moe_experts,
         "completed": len(sched.completed),
         "tokens": int(_metrics.counter(
             "bluefog_tokens_generated_total").total()),

@@ -60,6 +60,9 @@ from jax.sharding import NamedSharding
 from jax.sharding import PartitionSpec as P
 
 from ..models.transformer import apply_rope, apply_rope_grid, apply_rope_rows
+from ..moe.dropless import decode_tile
+from ..moe.layers import moe_dropless_combine, router_topk
+from ..moe.model import MoELMConfig
 from ..ops import pallas_decode as _pd
 from ..ops.ulysses import dense_attention
 from ..parallel.compose import AXES, LMConfig, Mesh3D, _ln, draft_carve
@@ -121,6 +124,44 @@ def _env_int(name: str, tok: str, grammar: str) -> int:
     return v
 
 
+_MOE_GRAMMAR = ("'<experts>[x<top_k>][@<ep>][:<tile>]' with positive ints "
+                "(e.g. '8', '8x2', '8x2@2:4'; tile in 1..8, omitted = "
+                "auto decode tile)")
+
+
+def _parse_serve_moe(spec: str) -> Tuple[int, int, int, int]:
+    """``"8x2@2:4"`` -> ``(experts=8, top_k=2, ep=2, tile=4)``.
+
+    ``top_k``/``ep``/``tile`` are optional (defaults 1/1/0, 0 meaning the
+    engine picks the decode tile via
+    :func:`~bluefog_tpu.moe.dropless.decode_tile`).  Malformed specs name
+    the offending token and the grammar, same contract as
+    :func:`_parse_buckets`.
+    """
+    body, _, tile_s = spec.partition(":")
+    body, _, ep_s = body.partition("@")
+    e_s, _, k_s = body.partition("x")
+
+    def intval(tok: str, what: str, lo: int) -> int:
+        tok = tok.strip()
+        try:
+            v = int(tok)
+        except ValueError:
+            raise ValueError(
+                f"BLUEFOG_SERVE_MOE={spec!r}: bad {what} token {tok!r} — "
+                f"expected " + _MOE_GRAMMAR) from None
+        if v < lo:
+            raise ValueError(
+                f"BLUEFOG_SERVE_MOE={spec!r}: {what} {tok!r} must be >= "
+                f"{lo} — expected " + _MOE_GRAMMAR)
+        return v
+
+    return (intval(e_s, "experts", 1),
+            intval(k_s, "top_k", 1) if k_s else 1,
+            intval(ep_s, "ep", 1) if ep_s else 1,
+            intval(tile_s, "tile", 1) if tile_s else 0)
+
+
 @dataclasses.dataclass(frozen=True)
 class ServeConfig:
     """Static serving shapes — everything that pins a compiled program.
@@ -167,6 +208,10 @@ class ServeConfig:
     temperature: float = 0.0
     top_p: float = 1.0
     seed: int = 0
+    moe_experts: int = 0        # 0 = dense model; >0 declares the MoE shape
+    moe_top_k: int = 1          # serving routes top-k only (k in {1, 2})
+    moe_ep: int = 1             # expert-parallel peers carved per replica
+    moe_tile: int = 0           # dropless decode tile rows (0 = auto)
 
     def __post_init__(self):
         if not self.batch_buckets or not self.prefill_buckets:
@@ -229,6 +274,27 @@ class ServeConfig:
                 "argmax-prefix agreement; sampled speculation needs the "
                 "full accept-reject rule (set temperature=0.0 or "
                 "spec_decode=0)")
+        if self.moe_experts < 0:
+            raise ValueError("moe_experts must be >= 0 (0 = dense model)")
+        if self.moe_experts:
+            if self.moe_top_k not in (1, 2):
+                raise ValueError(
+                    f"moe_top_k ({self.moe_top_k}) must be 1 or 2: serving "
+                    "routes top-k only")
+            if self.moe_ep < 1:
+                raise ValueError(f"moe_ep ({self.moe_ep}) must be >= 1")
+            if self.moe_experts % self.moe_ep:
+                raise ValueError(
+                    f"moe_serving_ep_mismatch: moe_experts "
+                    f"({self.moe_experts}) % moe_ep ({self.moe_ep}) != 0 — "
+                    "each expert-parallel peer owns a contiguous block of "
+                    f"experts; offender: moe_ep={self.moe_ep}")
+            if not 0 <= self.moe_tile <= 8:
+                raise ValueError(
+                    f"moe_tile ({self.moe_tile}) must be in [0, 8] (0 = "
+                    "auto): decode batches are tiny, so grouped tiles "
+                    "above 8 rows pad every expert group with mostly-zero "
+                    "tiles")
 
     @property
     def decode_window(self) -> int:
@@ -246,6 +312,7 @@ class ServeConfig:
         - ``BLUEFOG_KV_DTYPE='raw'|'int8'|'fp8'``
         - ``BLUEFOG_PREFIX_PAGES='<pages>'`` or ``'<pages>x<page_tokens>'``
         - ``BLUEFOG_DECODE_KERNEL='xla'|'pallas'`` or ``'pallas@<block_k>'``
+        - ``BLUEFOG_SERVE_MOE='<experts>[x<top_k>][@<ep>][:<tile>]'``
         """
         spec = os.environ.get("BLUEFOG_SERVE_BUCKETS", "")
         if spec:
@@ -296,6 +363,13 @@ class ServeConfig:
                 overrides.setdefault(
                     "prefix_page_tokens",
                     _env_int("BLUEFOG_PREFIX_PAGES", ptok_s, grammar))
+        sm = os.environ.get("BLUEFOG_SERVE_MOE", "")
+        if sm:
+            experts, top_k, ep, tile = _parse_serve_moe(sm)
+            overrides.setdefault("moe_experts", experts)
+            overrides.setdefault("moe_top_k", top_k)
+            overrides.setdefault("moe_ep", ep)
+            overrides.setdefault("moe_tile", tile)
         return cls(**overrides)
 
     def batch_bucket_for(self, lanes: int) -> int:
@@ -333,11 +407,45 @@ class ServeEngine:
             raise ValueError(
                 "serving decodes one token at a time; an sp > 1 carving has "
                 "no sequence to shard — fold sp into tp for inference")
+        self._moe = isinstance(cfg, MoELMConfig)
+        if self._moe and cfg.router_mode == "expert_choice":
+            raise ValueError(
+                "moe_serving_requires_topk_router: expert-choice routing "
+                "selects each expert's top-C tokens over the WHOLE "
+                "sequence, but autoregressive decode sees one token at a "
+                "time — an EC router at serve time would condition routing "
+                "on future tokens (the causality caveat that keeps it "
+                "training-only).  Serve with router_mode='topk'.")
         cfg.validate(m)
         scfg = scfg or ServeConfig.from_env()
         if scfg.max_len < scfg.prefill_buckets[-1] + scfg.decode_window:
             raise ValueError("max_len leaves no room to decode past the "
                              "longest prompt bucket")
+        if scfg.moe_experts and not self._moe:
+            raise ValueError(
+                f"ServeConfig declares an MoE (moe_experts="
+                f"{scfg.moe_experts}, via BLUEFOG_SERVE_MOE or --serve-moe) "
+                "but the model config is dense — build an MoELMConfig or "
+                "drop the knob")
+        if self._moe and scfg.moe_experts:
+            for knob, mine in (("moe_experts", cfg.num_experts),
+                               ("moe_top_k", cfg.top_k),
+                               ("moe_ep", m.ep)):
+                declared = getattr(scfg, knob)
+                if declared != mine:
+                    raise ValueError(
+                        f"ServeConfig.{knob}={declared} does not match the "
+                        f"model/carving value {mine} — the serve-MoE knob "
+                        "must agree with the MoELMConfig and the ep carve")
+        if self._moe:
+            e_local = cfg.num_experts // m.ep
+            # decode tile: every ep peer contributes its (replicated) lane
+            # rows, so the per-device grouped buffer sees ep * S * k rows
+            # over e_local groups
+            self._moe_tile = scfg.moe_tile or decode_tile(
+                m.ep * scfg.batch_buckets[-1] * cfg.top_k, e_local)
+            self._moe_chunk_tile = cfg.group_tile   # prefill/verify shapes
+        self._route_stats: Optional[np.ndarray] = None
         self.m, self.cfg, self.scfg = m, cfg, scfg
         self.draft = draft_carve(m, cfg, scfg.spec_stages) \
             if scfg.spec_decode else None
@@ -430,7 +538,57 @@ class ServeEngine:
 
         return jax.vmap(one)(logits, keys)
 
-    def _layer_step(self, lp, x, cl, slot_ids, lens, prows, plens):
+    def _ffn(self, lp, x, *, tile=None, draft=False):
+        """The post-attention FFN sublayer on ``[..., D]`` activations.
+
+        Dense models run the reference two-matmul gelu FFN.  MoE models
+        route through the dropless grouped-GEMM path (top-k router →
+        sort-based dispatch → grouped GEMM → combine); ``tile`` is the
+        grouped tile (the small decode tile on the hot path, the training
+        tile for prefill/verify shapes).  ``draft=True`` is the
+        spec-decode draft: the expert-MEAN dense FFN (one matmul pair at
+        active-param cost, no dispatch) — causally safe because the
+        verify chunk overwrites every drafted KV row and the accept rule
+        only ever emits target-argmax tokens, so draft quality affects
+        throughput, never the stream.
+
+        Returns ``(x, routing)`` — ``routing`` is ``(probs, idx)`` from
+        the router on the routed path (for hot-expert accounting), else
+        ``None``.
+        """
+        h = _ln(x)
+        if not self._moe:
+            return x + lax.psum(jax.nn.gelu(h @ lp["w1"]) @ lp["w2"],
+                                "tp"), None
+        E = self.cfg.num_experts
+        shp = x.shape
+        hf = h.reshape(-1, shp[-1])
+        if draft:
+            w1d = lax.psum(jnp.sum(lp["w1e"], axis=0), "expert") / E
+            w2d = lax.psum(jnp.sum(lp["w2e"], axis=0), "expert") / E
+            y = lax.psum(jax.nn.gelu(hf @ w1d) @ w2d, "tp")
+            return x + y.reshape(shp), None
+        logits, probs, idx, gate = router_topk(hf, lp["wr"],
+                                               top_k=self.cfg.top_k)
+        y = moe_dropless_combine(
+            hf, idx, gate, lp["w1e"], lp["w2e"], num_experts=E,
+            axis="expert", tile=self._moe_tile if tile is None else tile)
+        return x + y.reshape(shp), (probs, idx)
+
+    def _route_vec(self, routing, live):
+        """Fold one layer's routing into the ``[E + 2]`` stats carrier:
+        per-expert top-1 counts over live lanes, summed live-token router
+        entropy, live-token count."""
+        probs, idx = routing
+        E = self.cfg.num_experts
+        w = live.astype(jnp.float32)
+        cnt = jnp.sum(jax.nn.one_hot(idx[:, 0], E, dtype=jnp.float32)
+                      * w[:, None], axis=0)
+        ent = jnp.sum(-jnp.sum(probs * jnp.log(probs + 1e-20), axis=-1) * w)
+        return jnp.concatenate([cnt, ent[None], jnp.sum(w)[None]])
+
+    def _layer_step(self, lp, x, cl, slot_ids, lens, prows, plens,
+                    draft=False):
         """One decoder block on one new token per lane: ``x`` is ``[S, D]``."""
         cfg, m = self.cfg, self.m
         Hl = cfg.heads // m.tp
@@ -455,9 +613,8 @@ class ServeEngine:
                                   v_scale=cl.get("v_scale"),
                                   prefix_slots=prows, prefix_lens=plens)
         x = x + lax.psum(att.reshape(S, Hl * hsz) @ lp["wo"], "tp")
-        h = _ln(x)
-        x = x + lax.psum(jax.nn.gelu(h @ lp["w1"]) @ lp["w2"], "tp")
-        return x, cl
+        x, routing = self._ffn(lp, x, draft=draft)
+        return x, cl, routing
 
     def _pp_cycle(self, blocks, x, cache, stage_apply, n_stages=None):
         """Cycle ``x`` through ``n_stages`` pipeline stages (all of them by
@@ -469,41 +626,77 @@ class ServeEngine:
         broadcasts them."""
         n = self.m.pp if n_stages is None else n_stages
         sid = lax.axis_index("stage")
+        perm = [(i, (i + 1) % self.m.pp) for i in range(self.m.pp)]
         for s in range(n):
             y, nc = stage_apply(blocks, x, cache)
             keep = sid == s
-            x = jnp.where(keep, y, x)
+            # x may be a pytree carrier (activation + stats accumulator on
+            # the MoE decode path) — keep/permute leafwise
+            x = jax.tree.map(lambda new, old: jnp.where(keep, new, old),
+                             y, x)
             cache = jax.tree.map(
                 lambda new, old: jnp.where(keep, new, old), nc, cache)
-            x = lax.ppermute(
-                x, "stage",
-                [(i, (i + 1) % self.m.pp) for i in range(self.m.pp)])
+            x = jax.tree.map(lambda t: lax.ppermute(t, "stage", perm), x)
         return x, cache, sid
+
+    def _blocks_tree(self, params):
+        """Per-layer scanned leaves: the dense block weights, plus the
+        router/expert tables merged in on the MoE path (all leading-[Lps],
+        so one ``lax.scan`` pairs every layer's leaves)."""
+        if not self._moe:
+            return params["blocks"]
+        bp = dict(params["blocks"])
+        bp["wr"] = params["router"]["wr"]
+        bp["w1e"] = params["experts"]["w1"]
+        bp["w2e"] = params["experts"]["w2"]
+        return bp
 
     def _decode_scan(self, params, cache, toks, slot_ids, lens, prows,
                      plens, keys, *, steps, n_stages=None):
         """The shared fused-decode scan: ``steps`` tokens, optionally on a
         truncated (draft) stage cycle.  Returns ``(gen [steps, S], keys,
-        cache)``."""
+        cache, stats)`` — ``stats`` is the accumulated ``[E + 2]``
+        hot-expert carrier on the routed MoE path (it rides the same
+        keep/ppermute carrier as the activation, so each stage's layers
+        fold in exactly once), else ``None``."""
         embed = params["shared"]["embed"]
         head = params["shared"]["head"]
-        bp = params["blocks"]
+        bp = self._blocks_tree(params)
+        draft = n_stages is not None
         out_stage = (self.m.pp if n_stages is None else n_stages) % self.m.pp
+        track = self._moe and not draft
+        live = slot_ids < self.scfg.slots                 # [S] real lanes
 
         def step(carry, _):
-            toks, lens, cache, keys = carry
+            toks, lens, cache, keys, st = carry
 
-            def stage_apply(blocks, x, c):
-                def one(x, xs):
-                    lp, cl = xs
-                    x, cl = self._layer_step(lp, x, cl, slot_ids, lens,
-                                             prows, plens)
-                    return x, cl
-                return lax.scan(one, x, (blocks, c))
+            if track:
+                def stage_apply(blocks, xc, c):
+                    def one(xc, xs):
+                        x, acc = xc
+                        lp, cl = xs
+                        x, cl, routing = self._layer_step(
+                            lp, x, cl, slot_ids, lens, prows, plens)
+                        return (x, acc + self._route_vec(routing, live)), cl
+                    return lax.scan(one, xc, (blocks, c))
+                x0 = (embed[toks], st)                        # [S, D] + [E+2]
+            else:
+                def stage_apply(blocks, x, c):
+                    def one(x, xs):
+                        lp, cl = xs
+                        x, cl, _ = self._layer_step(lp, x, cl, slot_ids,
+                                                    lens, prows, plens,
+                                                    draft=draft)
+                        return x, cl
+                    return lax.scan(one, x, (blocks, c))
+                x0 = embed[toks]                              # [S, D]
 
-            x = embed[toks]                                   # [S, D]
-            x, cache, sid = self._pp_cycle(bp, x, cache, stage_apply,
+            x, cache, sid = self._pp_cycle(bp, x0, cache, stage_apply,
                                            n_stages=n_stages)
+            if track:
+                x, acc = x
+                st = lax.psum(jnp.where(sid == out_stage, acc, 0.0),
+                              "stage")
             logits = lax.psum(
                 jnp.where(sid == out_stage, _ln(x) @ head, 0.0), "stage")
             if n_stages is None:
@@ -511,11 +704,13 @@ class ServeEngine:
             else:
                 nxt = jnp.argmax(logits, axis=-1)     # draft: greedy only
             nxt = nxt.astype(toks.dtype)
-            return (nxt, lens + 1, cache, keys), nxt
+            return (nxt, lens + 1, cache, keys, st), nxt
 
-        (_, _, cache, keys), gen = lax.scan(
-            step, (toks, lens, cache, keys), None, length=steps)
-        return gen, keys, cache
+        st0 = jnp.zeros((self.cfg.num_experts + 2,), jnp.float32) \
+            if track else jnp.zeros((), jnp.float32)
+        (_, _, cache, keys, st), gen = lax.scan(
+            step, (toks, lens, cache, keys, st0), None, length=steps)
+        return gen, keys, cache, (st if track else None)
 
     def _split_args(self, args):
         return jax.tree.map(lambda t: t[0], args)
@@ -525,10 +720,11 @@ class ServeEngine:
         params, cache, toks, slot_ids, lens, prows, plens, keys = \
             self._split_args((params, cache, toks, slot_ids, lens, prows,
                               plens, keys))
-        gen, keys, cache = self._decode_scan(
+        gen, keys, cache, st = self._decode_scan(
             params, cache, toks, slot_ids, lens, prows, plens, keys,
             steps=self.scfg.decode_steps_per_call)
-        return jax.tree.map(lambda t: t[None], (gen, keys, cache))
+        out = (gen, keys, st, cache) if self._moe else (gen, keys, cache)
+        return jax.tree.map(lambda t: t[None], out)
 
     def _draft_body(self, params, cache, toks, slot_ids, lens, prows,
                     plens):
@@ -540,7 +736,7 @@ class ServeEngine:
             self._split_args((params, cache, toks, slot_ids, lens, prows,
                               plens))
         keys = jnp.zeros(toks.shape + (2,), jnp.uint32)   # greedy: unused
-        gen, _, cache = self._decode_scan(
+        gen, _, cache, _ = self._decode_scan(
             params, cache, toks, slot_ids, lens, prows, plens, keys,
             steps=self.scfg.spec_decode, n_stages=self.draft.stages)
         return jax.tree.map(lambda t: t[None], (gen, cache))
@@ -562,41 +758,54 @@ class ServeEngine:
         hsz = cfg.d_model // cfg.heads
         S, T = toks.shape
         pos = lens[:, None] + jnp.arange(T)[None, :]          # [S, T]
+        # chunk rows of live lanes all count toward the hot-expert stats
+        # (a spec-verify chunk is all real positions; chunked-prefill pad
+        # positions add bounded noise to the gauges, never to the math)
+        live = jnp.broadcast_to((slot_ids < self.scfg.slots)[:, None],
+                                (S, T)).reshape(S * T)
 
-        def stage_apply(blocks, x, c):
-            def one(x, xs):
-                lp, cl = xs
-                h = _ln(x)
-                q, k, v = jnp.split(h @ lp["wqkv"], 3, axis=-1)
-                q = apply_rope_grid(q.reshape(S, T, Hl, hsz), pos)
-                k = apply_rope_grid(k.reshape(S, T, Hl, hsz), pos)
-                v = v.reshape(S, T, Hl, hsz)
-                cl = _kv.layer_append_chunk(cl, slot_ids, lens, k, v,
-                                            store=self.scfg.kv_dtype)
-                if self.scfg.decode_kernel == "pallas":
-                    att = _pd.flash_attend_chunk(
-                        q, cl, slot_ids, lens,
-                        prefix_slots=prows, prefix_lens=plens,
-                        block_k=self.scfg.decode_block_k)
-                else:
-                    att = _kv.attend_chunk(q, cl, slot_ids, lens,
-                                           prefix_slots=prows,
-                                           prefix_lens=plens)
-                x = x + lax.psum(
-                    att.reshape(S, T, Hl * hsz) @ lp["wo"], "tp")
-                h = _ln(x)
-                x = x + lax.psum(
-                    jax.nn.gelu(h @ lp["w1"]) @ lp["w2"], "tp")
-                return x, cl
-            return lax.scan(one, x, (blocks, c))
+        def one(xc, xs):
+            x, acc = xc
+            lp, cl = xs
+            h = _ln(x)
+            q, k, v = jnp.split(h @ lp["wqkv"], 3, axis=-1)
+            q = apply_rope_grid(q.reshape(S, T, Hl, hsz), pos)
+            k = apply_rope_grid(k.reshape(S, T, Hl, hsz), pos)
+            v = v.reshape(S, T, Hl, hsz)
+            cl = _kv.layer_append_chunk(cl, slot_ids, lens, k, v,
+                                        store=self.scfg.kv_dtype)
+            if self.scfg.decode_kernel == "pallas":
+                att = _pd.flash_attend_chunk(
+                    q, cl, slot_ids, lens,
+                    prefix_slots=prows, prefix_lens=plens,
+                    block_k=self.scfg.decode_block_k)
+            else:
+                att = _kv.attend_chunk(q, cl, slot_ids, lens,
+                                       prefix_slots=prows,
+                                       prefix_lens=plens)
+            x = x + lax.psum(
+                att.reshape(S, T, Hl * hsz) @ lp["wo"], "tp")
+            x, routing = self._ffn(lp, x, tile=self._moe_chunk_tile
+                                   if self._moe else None)
+            if self._moe:
+                acc = acc + self._route_vec(routing, live)
+            return (x, acc), cl
 
+        def stage_apply(blocks, xc, c):
+            return lax.scan(one, xc, (blocks, c))
+
+        st0 = jnp.zeros((cfg.num_experts + 2,) if self._moe else (),
+                        jnp.float32)
         x = params["shared"]["embed"][toks]                   # [S, T, D]
-        x, cache, sid = self._pp_cycle(params["blocks"], x, cache,
-                                       stage_apply)
+        (x, st), cache, sid = self._pp_cycle(
+            self._blocks_tree(params), (x, st0), cache, stage_apply)
         logits = lax.psum(
             jnp.where(sid == 0, _ln(x) @ params["shared"]["head"], 0.0),
             "stage")                                          # [S, T, V]
         gen = jnp.argmax(logits, axis=-1).astype(toks.dtype)
+        if self._moe:
+            st = lax.psum(jnp.where(sid == 0, st, 0.0), "stage")
+            return jax.tree.map(lambda t: t[None], (gen, st, cache))
         return jax.tree.map(lambda t: t[None], (gen, cache))
 
     def _prefill_body(self, params, cache, toks, slot_id, true_len):
@@ -627,13 +836,12 @@ class ServeEngine:
                 att = dense_attention(q, k, v, causal=True)
                 x = x + lax.psum(
                     att.reshape(1, Tpad, Hl * hsz) @ lp["wo"], "tp")
-                h = _ln(x)
-                x = x + lax.psum(
-                    jax.nn.gelu(h @ lp["w1"]) @ lp["w2"], "tp")
+                x, _ = self._ffn(lp, x, tile=self._moe_chunk_tile
+                                 if self._moe else None)
                 return x, cl
             return lax.scan(one, x, (blocks, c))
 
-        x, cache, sid = self._pp_cycle(params["blocks"], x, cache,
+        x, cache, sid = self._pp_cycle(self._blocks_tree(params), x, cache,
                                        stage_apply)
         logits = jnp.where(sid == 0, _ln(x[0]) @ params["shared"]["head"],
                            0.0)                               # [Tpad, V]
@@ -787,13 +995,17 @@ class ServeEngine:
         prows, plens = self._prefix_args(prows, plens, toks.shape[1])
         traced = _tracing.enabled()
         t0 = time.monotonic() if traced else 0.0
-        gen, self.cache = self._chunk_jit(
-            self.params, self.cache,
-            self._expand(np.asarray(toks, np.int32)),
-            self._expand(np.asarray(slots, np.int32)),
-            self._expand(np.asarray(lens, np.int32)),
-            self._expand(prows) if prows is not None else None,
-            self._expand(plens) if plens is not None else None)
+        args = (self.params, self.cache,
+                self._expand(np.asarray(toks, np.int32)),
+                self._expand(np.asarray(slots, np.int32)),
+                self._expand(np.asarray(lens, np.int32)),
+                self._expand(prows) if prows is not None else None,
+                self._expand(plens) if plens is not None else None)
+        if self._moe:
+            gen, st, self.cache = self._chunk_jit(*args)
+            self._note_route_stats(st)
+        else:
+            gen, self.cache = self._chunk_jit(*args)
         self._check_retrace(f"chunk S={toks.shape[1]} T={toks.shape[2]}")
         out = self._collect(gen)
         if traced:
@@ -826,14 +1038,18 @@ class ServeEngine:
         keys = self._gather_keys(slots)
         traced = _tracing.enabled()
         t0 = time.monotonic() if traced else 0.0
-        gen, keys, self.cache = self._decode_jit(
-            self.params, self.cache,
-            self._expand(np.asarray(tokens, np.int32)),
-            self._expand(slots),
-            self._expand(np.asarray(lens, np.int32)),
-            self._expand(prows) if prows is not None else None,
-            self._expand(plens) if plens is not None else None,
-            self._expand(keys))
+        args = (self.params, self.cache,
+                self._expand(np.asarray(tokens, np.int32)),
+                self._expand(slots),
+                self._expand(np.asarray(lens, np.int32)),
+                self._expand(prows) if prows is not None else None,
+                self._expand(plens) if plens is not None else None,
+                self._expand(keys))
+        if self._moe:
+            gen, keys, st, self.cache = self._decode_jit(*args)
+            self._note_route_stats(st)
+        else:
+            gen, keys, self.cache = self._decode_jit(*args)
         self._scatter_keys(slots, self._collect(keys))
         self._check_retrace(f"decode S={S}")
         out = self._collect(gen)
@@ -917,6 +1133,59 @@ class ServeEngine:
         """(token, slot, len) triple a padding lane should carry."""
         return 0, self.cache_cfg.trash_slot, 0
 
+    def _note_route_stats(self, st: jax.Array) -> None:
+        """Fold one MoE call's ``[R, E + 2]`` hot-expert carrier into the
+        last-call snapshot (per-expert top-1 counts over live lanes and
+        layers, summed router entropy, live token-layer count)."""
+        self._route_stats = self._collect(st).astype(np.float64)
+
+    def moe_load(self) -> Optional[list]:
+        """Per-replica routing load from the most recent MoE engine call
+        (fused decode, or the spec-verify chunk): a list of ``m.dp``
+        dicts with ``fractions`` (``[E]`` top-1 dispatch fractions),
+        ``counts`` (raw live token-layer counts), ``entropy`` (mean
+        live-token router entropy, nats) and ``tokens`` (live token-layer
+        count).  ``None`` for dense engines or before the first call with
+        a live lane — the expert-load-aware scheduler and serve_bench's
+        hot-expert histogram read this."""
+        if not self._moe or self._route_stats is None:
+            return None
+        E = self.cfg.num_experts
+        out = []
+        for r in range(self.m.dp):
+            cnt = self._route_stats[r, :E]
+            tot = float(cnt.sum())
+            n = float(self._route_stats[r, E + 1])
+            out.append({
+                "counts": cnt.copy(),
+                "fractions": cnt / tot if tot else np.zeros(E),
+                "entropy": float(self._route_stats[r, E]) / n if n else 0.0,
+                "tokens": n,
+            })
+        return out
+
+    def decode_lowered_text(self, batch: Optional[int] = None) -> str:
+        """Pre-optimization StableHLO of one fused-decode bucket (the
+        largest by default) — serve_bench and the AOT tests classify its
+        collectives with :func:`~bluefog_tpu.utils.hlo_bytes.
+        stablehlo_wire_stats` to prove the MoE dispatch/combine
+        all_to_alls (and the pp/tp collectives) stay ICI-side.  Lowering
+        only: nothing executes and the donated cache stays alive."""
+        S = batch if batch is not None else self.scfg.batch_buckets[-1]
+        if S not in self.scfg.batch_buckets:
+            raise ValueError(f"batch lane count {S} is not a declared "
+                             f"bucket {self.scfg.batch_buckets}")
+        tok, slot, ln = self.idle_lane()
+        full = lambda v: np.full((self.m.dp, S), v, np.int32)
+        prows, plens = self._prefix_args(None, None, S)
+        args = (self.params, self.cache,
+                self._expand(full(tok)), self._expand(full(slot)),
+                self._expand(full(ln)),
+                self._expand(prows) if prows is not None else None,
+                self._expand(plens) if plens is not None else None,
+                self._expand(self._gather_keys(full(slot))))
+        return self._decode_jit.lower(*args).as_text()
+
     def update_params(self, params: Any) -> None:
         """Swap in a fresh ``[n, ...]``-stacked tree (shapes must match —
         a shape change would retrace, which the sentinel will report)."""
@@ -950,7 +1219,10 @@ class ServeEngine:
                        prefill_buckets=list(scfg.prefill_buckets),
                        spec_decode=scfg.spec_decode,
                        prefix_pages=scfg.prefix_pages,
-                       kv_dtype=scfg.kv_dtype)
+                       kv_dtype=scfg.kv_dtype,
+                       moe_experts=self.cfg.num_experts if self._moe else 0,
+                       moe_ep=self.m.ep if self._moe else 0,
+                       moe_tile=self._moe_tile if self._moe else 0)
         _metrics.mark_steady_state(True)
 
     def _jit_sizes(self) -> Tuple[int, ...]:

@@ -55,22 +55,32 @@ class WeightRefresher:
     """Periodically pull training params into a :class:`ServeEngine`.
 
     ``train_m`` is the *training* carving; its intra-slice layout
-    (pp, tp, sp) must match the serving carving so that row ``r *
+    (pp, tp, sp, ep) must match the serving carving so that row ``r *
     slice_size + o`` of the training tree and row ``q * slice_size + o``
-    of the serving tree hold the same (stage, tp) shard.  The param trees
-    stay ``[n, ...]``-stacked throughout — the combined tree is simply
-    their concatenation along the rank row axis.
+    of the serving tree hold the same (stage, tp, expert-block) shard.
+    The param trees stay ``[n, ...]``-stacked throughout — the combined
+    tree is simply their concatenation along the rank row axis.  MoE
+    trees need no special casing: the router and per-peer expert-table
+    leaves are floating ``[n, ...]`` rows like any block weight, so the
+    same leaf pull averages them across the training dp replicas.
     """
 
     def __init__(self, engine: ServeEngine, train_m: Mesh3D, *,
                  every: Optional[int] = None):
-        if (train_m.pp, train_m.tp, train_m.sp) != (
-                engine.m.pp, engine.m.tp, engine.m.sp):
+        if (train_m.pp, train_m.tp, train_m.sp, train_m.ep) != (
+                engine.m.pp, engine.m.tp, engine.m.sp, engine.m.ep):
             raise ValueError(
                 f"training slice layout (pp={train_m.pp}, tp={train_m.tp}, "
-                f"sp={train_m.sp}) != serving layout (pp={engine.m.pp}, "
-                f"tp={engine.m.tp}, sp={engine.m.sp}); a pull copies "
-                "same-shard rows and cannot re-shard")
+                f"sp={train_m.sp}, ep={train_m.ep}) != serving layout "
+                f"(pp={engine.m.pp}, tp={engine.m.tp}, sp={engine.m.sp}, "
+                f"ep={engine.m.ep}); a pull copies same-shard rows and "
+                "cannot re-shard — an ep mismatch would hand a serve peer "
+                "another peer's expert-table block")
+        if (train_m.num_experts or 0) != (engine.m.num_experts or 0):
+            raise ValueError(
+                f"training carving num_experts={train_m.num_experts} != "
+                f"serving num_experts={engine.m.num_experts}; the expert "
+                "tables being pulled must slice identically")
         if every is None:
             every = int(os.environ.get("BLUEFOG_REFRESH_EVERY",
                                        DEFAULT_REFRESH_EVERY))

@@ -114,6 +114,7 @@ class Scheduler:
         self.failed: List[Request] = []
         self.requeued_total = 0
         self._decode_calls = 0
+        self._moe_load = None            # last ServeEngine.moe_load() snapshot
         self._slo = None                 # diagnostics.SLOEngine, if attached
         _flight.register_block("serve", self._flight_block)
 
@@ -349,7 +350,12 @@ class Scheduler:
             def _rank(r):
                 pc = self._prefix[r]
                 got = pc.match(head.prompt) if pc is not None else None
-                return (-(got[1] if got else 0), len(self._active[r]), r)
+                # expert-load-aware tiebreak: among equally-loaded
+                # replicas, prefer the one whose fused batch routes least
+                # pathologically (quantized so transient jitter never
+                # outranks a real load difference)
+                return (-(got[1] if got else 0), len(self._active[r]),
+                        self._expert_skew(r), r)
             target = min(candidates, key=_rank)
             req = self._queue.popleft()
             slot = self._alloc[target].alloc()
@@ -415,6 +421,7 @@ class Scheduler:
             gen_tokens = lambda r, i: [int(t) for t in gen[r, :, i]]
         dt = time.monotonic() - t0
         self._decode_calls += 1
+        self._note_moe_load()
         traced = _tracing.enabled()
         n_tokens = 0
         retired: List[Request] = []
@@ -452,6 +459,47 @@ class Scheduler:
             for _ in range(min(steps, 64)):   # bounded observer cost
                 h.observe(dt / max(steps, 1))
         return retired
+
+    def _note_moe_load(self) -> None:
+        """Snapshot the engine's per-replica routing load (None for dense
+        engines) and publish the hot-expert gauges the fleet watches:
+        the hottest expert's top-1 dispatch fraction, the mean router
+        entropy, and the full per-(replica, expert) fraction surface."""
+        self._moe_load = load = self.engine.moe_load()
+        if load is None:
+            return
+        hot = _metrics.gauge(
+            "bluefog_serve_hot_expert_fraction",
+            "top-1 dispatch fraction of the hottest expert in the last "
+            "fused MoE batch, per replica")
+        ent = _metrics.gauge(
+            "bluefog_serve_router_entropy",
+            "mean live-token router entropy (nats) of the last fused MoE "
+            "batch, per replica")
+        per = _metrics.gauge(
+            "bluefog_serve_expert_load_fraction",
+            "top-1 dispatch fraction per (replica, expert) in the last "
+            "fused MoE batch")
+        for r, row in enumerate(load):
+            if not row["tokens"]:
+                continue
+            hot.set(float(row["fractions"].max()), replica=r)
+            ent.set(row["entropy"], replica=r)
+            for e, f in enumerate(row["fractions"]):
+                per.set(float(f), replica=r, expert=e)
+
+    def _expert_skew(self, r: int) -> int:
+        """Quantized routing skew of replica ``r``'s last fused batch: the
+        hottest expert's excess dispatch fraction over perfect balance, in
+        eighths (0 for dense engines, balanced batches, or no data yet).
+        Admission uses this as a tiebreak so a replica whose batch already
+        hammers one expert peer stops attracting more load than its
+        balanced siblings."""
+        load = self._moe_load
+        if not load or r >= len(load) or not load[r]["tokens"]:
+            return 0
+        frac = load[r]["fractions"]
+        return int((float(frac.max()) - 1.0 / len(frac)) * 8)
 
     def _maybe_retire(self, req: Request) -> bool:
         # the next fused call appends at next_pos .. next_pos + window - 1,
@@ -518,6 +566,16 @@ class Scheduler:
             block["prefix_pages"] = {
                 str(r): self._prefix[r].describe()
                 for r in self.live_replicas() if self._prefix[r].in_use}
+        if self._moe_load is not None:
+            block["moe"] = {
+                str(r): {
+                    "fractions": [round(float(f), 6)
+                                  for f in row["fractions"]],
+                    "entropy": round(row["entropy"], 6),
+                    "tokens": row["tokens"],
+                    "skew_eighths": self._expert_skew(r),
+                }
+                for r, row in enumerate(self._moe_load) if row["tokens"]}
         return block
 
     def close(self) -> None:

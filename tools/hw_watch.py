@@ -266,6 +266,17 @@ def _battery_steps(tag: str, stage: int = 0) -> list:
                        "--out",
                        os.path.join(m, f"serve_bench_flash_{tag}.json")],
                       2400, None, None))
+        # the MoE row: ep-carved expert-parallel serving through the
+        # dropless grouped-GEMM decode path, 2-deep spec draft off the
+        # dense-FFN twin — gated on spec-vs-greedy token identity, the
+        # dense-twin tokens/s at equal active params, and zero DCN
+        # all_to_all bytes per chip (1+1 replicas x pp=2 x ep=2 = 8)
+        steps.append(("serve_bench_moe",
+                      [py, sb, "--train-dp", "1", "--serve-dp", "1",
+                       "--pp", "2", "--serve-moe", "4x2@2:4",
+                       "--spec-decode", "2@1", "--out",
+                       os.path.join(m, f"serve_bench_moe_{tag}.json")],
+                      2400, None, None))
         # the scale-event row: bursty flash-crowd traffic with a parked
         # reserve replica — the autoscaler must grow into the spike and
         # the schema-3 trace row demands zero failed requests + SLO
@@ -414,6 +425,12 @@ def _rehearsal_steps(tag: str) -> list:
           "--virtual-cpu", "--smoke", "--decode-kernel", "pallas@8",
           "--kv-dtype", "int8", "--prefix-pages", "2x8",
           "--out", os.path.join(m, f"serve_bench_flash_{tag}.json")], 900,
+         None, None),
+        ("serve_bench_moe",
+         [py, os.path.join(REPO, "tools", "serve_bench.py"),
+          "--virtual-cpu", "--smoke", "--serve-moe", "4x2@2:4",
+          "--spec-decode", "2@1",
+          "--out", os.path.join(m, f"serve_bench_moe_{tag}.json")], 900,
          None, None),
         ("serve_bench_trace",
          [py, os.path.join(REPO, "tools", "serve_bench.py"),

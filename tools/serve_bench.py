@@ -43,6 +43,20 @@ attention hot path timed at context x occupancy x KV-dtype points for
 both the configured kernel and the XLA reference, with achieved
 FLOPs/sec against the roofline ceiling.
 
+With ``--serve-moe E[xK][@EP][:TILE]`` (schema 5) the whole estate goes
+MoE: the training fleet optimizes a dropless routed-MoE LM
+(``make_moe_grad_fn``) and the serving engine decodes through the
+grouped-GEMM dropless path on an ``ep``-carved mesh, with the refresher
+pulling router + expert tables live.  The artifact gains a ``moe``
+section with (a) a greedy-token bit-identity gate (MoE speculative
+decode vs plain MoE greedy), (b) tokens/s against the **dense twin at
+equal active params** (``MoELMConfig.dense_twin`` — Switch-Transformer
+accounting) on the same prompts, (c) the router-entropy / hot-expert
+histogram the expert-load-aware scheduler reads, and (d) an AOT wire
+proof — the fused-decode program's collectives classified per chip with
+``stablehlo_wire_stats`` — gating that the dispatch/combine all_to_alls
+are ICI-side (zero DCN all_to_alls).
+
 With ``--traffic-trace`` (schema 3) the drain is followed by a bursty
 traffic phase driven by a synthetic arrival trace (``diurnal`` — one
 day-cycle sinusoid — or ``flash-crowd`` — a low base rate with a sudden
@@ -54,7 +68,7 @@ file on the way) and retire it after the cooldown.  The artifact's
 bound), scale events, and the requeued-vs-failed split — the gate
 demands **zero failed requests** across the scale events.
 
-Emits a ``bluefog-serve-bench-4`` JSON artifact (last stdout line, and
+Emits a ``bluefog-serve-bench-5`` JSON artifact (last stdout line, and
 ``--out``).
 
 Run:    python tools/serve_bench.py --train-dp 2 --serve-dp 2 --pp 2 --out ...
@@ -63,6 +77,8 @@ Fast:   python tools/serve_bench.py --virtual-cpu --smoke \
             --spec-decode 3@1 --prefix-pages 2x8 --kv-dtype int8
 Flash:  python tools/serve_bench.py --virtual-cpu --smoke \
             --decode-kernel pallas@8 --kv-dtype int8 --prefix-pages 2x8
+MoE:    python tools/serve_bench.py --virtual-cpu --smoke \
+            --serve-moe 4x2@2:4 --spec-decode 2@1
 Trace:  python tools/serve_bench.py --virtual-cpu --smoke \
             --traffic-trace flash-crowd
 """
@@ -78,7 +94,7 @@ import time
 REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
 sys.path.insert(0, REPO)
 
-SCHEMA = "bluefog-serve-bench-4"
+SCHEMA = "bluefog-serve-bench-5"
 
 
 def _trace_arrivals(shape, steps, slots, rng):
@@ -302,8 +318,8 @@ def _load_tool(name):
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--virtual-cpu", action="store_true",
-                    help="virtual CPU mesh sized (train_dp+serve_dp)*pp*tp "
-                         "(smoke/tests)")
+                    help="virtual CPU mesh sized (train_dp+serve_dp)*pp*tp"
+                         "*ep (smoke/tests)")
     ap.add_argument("--smoke", action="store_true",
                     help="tiny shapes for CI (implies quick compile)")
     ap.add_argument("--train-dp", type=int, default=2,
@@ -341,6 +357,11 @@ def main():
     ap.add_argument("--decode-kernel", default=None,
                     help="decode-attention backend: 'xla' or 'pallas' or "
                          "'pallas@<block_k>' (schema 4 row; default xla)")
+    ap.add_argument("--serve-moe", default=None,
+                    help="MoE estate: '<experts>[x<top_k>][@<ep>][:<tile>]'"
+                         " e.g. '4x2@2:4' — dropless routed MoE trained and"
+                         " served on ep-carved meshes (schema 5 row; "
+                         "default BLUEFOG_SERVE_MOE or dense)")
     ap.add_argument("--traffic-trace", default=None,
                     choices=("diurnal", "flash-crowd"),
                     help="bursty traffic phase with a parked reserve "
@@ -359,7 +380,17 @@ def main():
     ap.add_argument("--allow-cpu", action="store_true")
     args = ap.parse_args()
 
-    n_chips = (args.train_dp + args.serve_dp) * args.pp * args.tp
+    if args.serve_moe is None:
+        args.serve_moe = os.environ.get("BLUEFOG_SERVE_MOE") or None
+    # ep widens the slice, so it must enter the chip math before jax
+    # initializes; only the @ep token is read here — the full grammar is
+    # validated by engine._parse_serve_moe once the libraries are up
+    moe_ep = 1
+    if args.serve_moe:
+        ep_s = args.serve_moe.partition(":")[0].partition("@")[2]
+        if ep_s.isdigit():
+            moe_ep = int(ep_s)
+    n_chips = (args.train_dp + args.serve_dp) * args.pp * args.tp * moe_ep
     if args.virtual_cpu:
         flags = os.environ.get("XLA_FLAGS", "")
         if "host_platform_device_count" not in flags:
@@ -381,7 +412,7 @@ def main():
         sys.exit(2)
     if len(jax.devices()) < n_chips:
         raise SystemExit(
-            f"need {n_chips} devices for (train_dp+serve_dp)*pp*tp, "
+            f"need {n_chips} devices for (train_dp+serve_dp)*pp*tp*ep, "
             f"have {len(jax.devices())}")
 
     smoke = args.smoke or (args.virtual_cpu and not on_tpu)
@@ -418,18 +449,35 @@ def main():
     _tracing.configure(trace_dir)
 
     devs = jax.devices()
-    slice_sz = args.pp * args.tp
+    slice_sz = args.pp * args.tp * moe_ep
     train_devs = devs[:args.train_dp * slice_sz]
     serve_devs = devs[args.train_dp * slice_sz:n_chips]
 
-    cfg = compose.LMConfig(
-        vocab=vocab, d_model=d_model, heads=heads, layers=layers,
-        seq_len=32 if smoke else 128, micro=max(2 * args.pp, 2),
-        batch=2)
-    train_m = compose.compose_parallelism(
-        args.train_dp, args.pp, args.tp, 1, devices=train_devs)
-    serve_m = compose.compose_parallelism(
-        args.serve_dp, args.pp, args.tp, 1, devices=serve_devs)
+    lm_kw = dict(vocab=vocab, d_model=d_model, heads=heads, layers=layers,
+                 seq_len=32 if smoke else 128, micro=max(2 * args.pp, 2))
+    if args.serve_moe:
+        from bluefog_tpu.moe.model import (MoELMConfig, init_moe_params,
+                                           make_moe_batch, make_moe_grad_fn)
+        from bluefog_tpu.serve.engine import _parse_serve_moe
+        moe_E, moe_k, moe_ep_full, moe_tile = _parse_serve_moe(
+            args.serve_moe)
+        if moe_ep_full != moe_ep:
+            raise SystemExit(f"--serve-moe ep token {moe_ep_full} did not "
+                             f"survive the chip-math pre-parse ({moe_ep})")
+        cfg = MoELMConfig(batch=2 * moe_ep, num_experts=moe_E, top_k=moe_k,
+                          dispatch="dropless", **lm_kw)
+        train_m = compose.compose_parallelism(
+            args.train_dp, args.pp, args.tp, 1, moe_ep, devices=train_devs,
+            num_experts=moe_E)
+        serve_m = compose.compose_parallelism(
+            args.serve_dp, args.pp, args.tp, 1, moe_ep, devices=serve_devs,
+            num_experts=moe_E)
+    else:
+        cfg = compose.LMConfig(batch=2, **lm_kw)
+        train_m = compose.compose_parallelism(
+            args.train_dp, args.pp, args.tp, 1, devices=train_devs)
+        serve_m = compose.compose_parallelism(
+            args.serve_dp, args.pp, args.tp, 1, devices=serve_devs)
     cfg.validate(train_m)
 
     sc_kw = dict(slots=slots, max_len=max_len,
@@ -451,6 +499,9 @@ def main():
         sc_kw["prefix_pages"] = int(pg_s)
         if pt_s:
             sc_kw["prefix_page_tokens"] = int(pt_s)
+    if args.serve_moe:
+        sc_kw.update(moe_experts=moe_E, moe_top_k=moe_k, moe_ep=moe_ep,
+                     moe_tile=moe_tile)
     if args.buckets:
         bb, pb = _parse_buckets(args.buckets)
         scfg = ServeConfig(batch_buckets=bb, prefill_buckets=pb, **sc_kw)
@@ -458,16 +509,22 @@ def main():
         scfg = ServeConfig.from_env(**sc_kw)
 
     # -- training fleet -----------------------------------------------------
-    grad_fn = compose.make_lm_grad_fn(cfg, train_m)
+    if args.serve_moe:
+        grad_fn = make_moe_grad_fn(cfg, train_m)
+        train_params = init_moe_params(cfg, train_m, seed=1)
+        toks = make_moe_batch(cfg, train_m)
+    else:
+        grad_fn = compose.make_lm_grad_fn(cfg, train_m)
+        train_params = compose.init_lm_params(cfg, train_m, seed=1)
+        toks = compose.make_lm_batch(cfg, train_m)
     step, strategy = compose.make_train_step(
         train_m, grad_fn, optax.adam(5e-3))
-    train_params = compose.init_lm_params(cfg, train_m, seed=1)
     state = bfopt.init_distributed(strategy, train_params)
-    toks = compose.make_lm_batch(cfg, train_m)
     train_params = compose.device_put(train_m, train_params)
 
     # -- serving fleet ------------------------------------------------------
-    serve_params = compose.init_lm_params(cfg, serve_m, seed=0)
+    serve_params = (init_moe_params(cfg, serve_m, seed=0) if args.serve_moe
+                    else compose.init_lm_params(cfg, serve_m, seed=0))
     engine = ServeEngine(serve_m, cfg, serve_params, scfg)
     engine.warmup()
 
@@ -531,6 +588,59 @@ def main():
         flash_probe = {"prompts": len(probe_prompts),
                        "bit_identical": bool(ref == got)}
         del ref_eng
+
+    # probe (d), schema 5: MoE serving — greedy bit-identity through the
+    # speculative path, the dense twin at equal ACTIVE params timed on
+    # the same prompts, and the AOT wire split of the fused decode
+    moe_probe = None
+    if args.serve_moe:
+        from bluefog_tpu.utils.hlo_bytes import stablehlo_wire_stats
+
+        def _timed_tps(eng, prompts):
+            before = bfm.counter("bluefog_tokens_generated_total").total()
+            w0 = time.perf_counter()
+            _drain_tokens(eng, prompts)
+            wall = time.perf_counter() - w0
+            made = bfm.counter(
+                "bluefog_tokens_generated_total").total() - before
+            return (made / wall) if wall > 0 else None
+
+        moe_prompts = [rng.integers(0, vocab, int(rng.integers(
+            2, scfg.prefill_buckets[-1] + 1))).tolist() for _ in range(8)]
+        if spec_probe is not None:
+            bit = dict(spec_probe)          # spec-MoE vs plain-greedy-MoE
+        else:
+            spec_eng = ServeEngine(
+                serve_m, cfg, serve_params,
+                dataclasses.replace(scfg, spec_decode=2, spec_stages=1))
+            spec_eng.warmup()
+            got = [r.generated
+                   for r in _drain_tokens(spec_eng, moe_prompts[:3])]
+            ref = [r.generated
+                   for r in _drain_tokens(engine, moe_prompts[:3])]
+            bit = {"prompts": 3, "bit_identical": bool(ref == got)}
+            del spec_eng
+        # the fair baseline: same skeleton, ffn_mult scaled by top_k —
+        # equal FLOPs per token, 1/E-th the FFN capacity per chip set
+        dense_cfg = cfg.dense_twin()
+        dense_m = compose.compose_parallelism(
+            args.serve_dp, args.pp, args.tp, 1,
+            devices=serve_devs[:args.serve_dp * args.pp * args.tp])
+        dense_eng = ServeEngine(
+            dense_m, dense_cfg,
+            compose.init_lm_params(dense_cfg, dense_m, seed=0),
+            dataclasses.replace(scfg, moe_experts=0, moe_top_k=1,
+                                moe_ep=1, moe_tile=0))
+        dense_eng.warmup()
+        moe_tps = _timed_tps(engine, moe_prompts)
+        dense_tps = _timed_tps(dense_eng, moe_prompts)
+        del dense_eng
+        moe_probe = {
+            "bit": bit, "tps_moe": moe_tps, "tps_dense": dense_tps,
+            "dense_n_params": dense_cfg.n_params,
+            "wire": stablehlo_wire_stats(engine.decode_lowered_text(),
+                                         serve_m.slice_size),
+        }
 
     refresher = WeightRefresher(engine, train_m, every=refresh_every)
     sched = Scheduler(engine)
@@ -603,7 +713,10 @@ def main():
             n_tok += 1
             ctx_sum += p + i
     avg_ctx = (ctx_sum / n_tok) if n_tok else 0.0
-    decode_flops_per_token = (2.0 * cfg.n_params
+    # MoE: the weight term counts ACTIVE params only — a decoded token
+    # touches its top-k experts, not the full table
+    n_weight = getattr(cfg, "n_active_params", cfg.n_params)
+    decode_flops_per_token = (2.0 * n_weight
                               + 4.0 * cfg.layers * cfg.d_model * avg_ctx)
     bench = _load_tool("bench")
     peak = bench._peak_flops(dev.device_kind) if on_tpu else None
@@ -667,6 +780,50 @@ def main():
                 scfg, heads, d_model // heads, kernel=scfg.decode_kernel,
                 block_k=scfg.decode_block_k, on_tpu=on_tpu, peak=peak,
                 iters=3 if smoke else 20),
+        }
+
+    # -- MoE serving rows (schema 5) -----------------------------------------
+    moe_doc = None
+    if moe_probe is not None:
+        ws = moe_probe["wire"]
+        a2a_ici = ws["ici"].get("all_to_all", {"count": 0, "bytes": 0})
+        a2a_dcn = ws["dcn"].get("all_to_all", {"count": 0, "bytes": 0})
+        live = [row for row in (engine.moe_load() or []) if row["tokens"]]
+        hist = (np.mean([row["fractions"] for row in live], axis=0)
+                if live else np.zeros(scfg.moe_experts))
+        tps_m, tps_d = moe_probe["tps_moe"], moe_probe["tps_dense"]
+        moe_doc = {
+            "experts": scfg.moe_experts,
+            "top_k": scfg.moe_top_k,
+            "ep": scfg.moe_ep,
+            "tile": engine._moe_tile,
+            "n_params_total": cfg.n_params,
+            "n_params_active": cfg.n_active_params,
+            "dense_twin_n_params": moe_probe["dense_n_params"],
+            "tokens_per_sec_moe": round(tps_m, 1) if tps_m else None,
+            "tokens_per_sec_dense_twin": (round(tps_d, 1)
+                                          if tps_d else None),
+            "vs_dense_equal_active": (round(tps_m / tps_d, 4)
+                                      if tps_m and tps_d else None),
+            "serve_chips_moe": args.serve_dp * slice_sz,
+            "serve_chips_dense_twin": args.serve_dp * args.pp * args.tp,
+            "bit_identity": moe_probe["bit"],
+            "router_entropy_mean": (round(float(np.mean(
+                [row["entropy"] for row in live])), 4) if live else None),
+            "hot_expert": {
+                "counts": [int(c) for c in (np.sum(
+                    [row["counts"] for row in live], axis=0) if live
+                    else np.zeros(scfg.moe_experts))],
+                "fractions": [round(float(f), 4) for f in hist],
+                "max_fraction": (round(float(hist.max()), 4)
+                                 if len(hist) else None),
+            },
+            "wire": {
+                "per_chip_ici_bytes": ws["ici_bytes"],
+                "per_chip_dcn_bytes": ws["dcn_bytes"],
+                "all_to_all_ici": a2a_ici,
+                "all_to_all_dcn": a2a_dcn,
+            },
         }
 
     # -- per-request latency breakdown from the tracer ----------------------
@@ -740,6 +897,7 @@ def main():
         "prefix": prefix_doc,
         "kv": kv_doc,
         "decode": decode_doc,
+        "moe": moe_doc,
         "trace": trace_doc,
         "latency_breakdown": breakdown_doc,
         "invariants": {
@@ -758,6 +916,15 @@ def main():
         fast_ok &= kv_doc["ratio"] <= 0.5
     if decode_doc is not None:
         fast_ok &= decode_doc["bit_identical"]
+    if moe_doc is not None:
+        # the ISSUE 19 gate: spec-vs-greedy token identity, a measured
+        # dense-twin comparison, and dispatch/combine a2a traffic that is
+        # entirely intra-slice (ICI) — any DCN all_to_all fails the run
+        fast_ok &= bool(moe_doc["bit_identity"]["bit_identical"]
+                        and moe_doc["tokens_per_sec_moe"]
+                        and moe_doc["tokens_per_sec_dense_twin"]
+                        and moe_doc["wire"]["all_to_all_ici"]["count"] >= 1
+                        and moe_doc["wire"]["all_to_all_dcn"]["count"] == 0)
     doc["ok"] = bool(len(sched.completed) == n_requests
                      and doc["invariants"]["donation_intact"]
                      and retraces == 0

@@ -11,7 +11,7 @@ PY ?= python
 	autotune-smoke elastic-smoke lm-smoke moe-smoke moe-fast-smoke \
 	serve-smoke \
 	serve-fast-smoke flash-decode-smoke moe-serve-smoke \
-	async-smoke regrow-smoke preempt-smoke
+	async-smoke regrow-smoke preempt-smoke fleet-smoke
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -429,6 +429,29 @@ autotune-smoke:
 		assert a['considered'] == len(a['scored']) + len(a['rejected']), a; \
 		assert all(r['reason'] for r in a['rejected']), a; \
 		print('autotune-smoke OK')"
+
+# fleet-view smoke: the gossiped-aggregation pytest battery (the 8-rank
+# drill, numpy ground truth through churn, breach-anywhere contracts, the
+# endpoint/hygiene/hot-path pins) plus fleet_top against a live estate —
+# train with the carrier armed, scrape the tool's own /fleet over HTTP,
+# gate on the schema + the zero-retrace/health invariants
+fleet-smoke:
+	$(PY) -m pytest tests/test_fleetview.py -q -m "not slow"
+	$(PY) tools/fleet_top.py --virtual-cpu --once --json \
+		--out /tmp/fleet_top_smoke.json
+	$(PY) -c "import json; \
+		d = json.load(open('/tmp/fleet_top_smoke.json')); \
+		assert d['ok'] and d['schema'] == 'bluefog-fleet-1', d; \
+		assert d['n'] == 8 and d['seen_ranks'] == list(range(8)), d; \
+		st = d['staleness']; \
+		assert st['rounds_max'] <= st['bound_rounds'], st; \
+		c = d['metrics']['bluefog_train_steps_total']; \
+		assert c['kind'] == 'counter' and c['global'] > 0 and \
+		len(c['per_rank']) == 8, c; \
+		i = d['invariants']; \
+		assert i['retraces_after_warmup'] == 0 and i['healthz_ok'] and \
+		i['fleet_armed'], i; \
+		print('fleet-smoke OK')"
 
 # background TPU-tunnel watcher: probes every ~10 min, runs the full
 # measurement battery unattended on the first success (tools/hw_watch.py)

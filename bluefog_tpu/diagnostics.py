@@ -41,6 +41,7 @@ from . import ops
 from .parallel import context as _mesh
 from .schedule import CommSchedule
 from .utils import chaos as _chaos
+from .utils import fleetview as _fleetview
 from .utils import flight as _flight
 from .utils import metrics as _metrics
 from .utils import timeseries as _ts
@@ -73,7 +74,8 @@ def _flat_f32(tree) -> jax.Array:
 
 
 def _probe_program(ctx, sched: Optional[CommSchedule], sig,
-                   dead: tuple = (), with_time: bool = False):
+                   dead: tuple = (), with_time: bool = False,
+                   fleet_len: int = 0):
     """Compiled probe: distributed params -> (distance [n], disagreement [n]).
 
     ``dead`` restricts the network average (and the disagreement mask) to
@@ -89,6 +91,16 @@ def _probe_program(ctx, sched: Optional[CommSchedule], sig,
     in-neighbors' times, the straggler detector's raw signal.  The flag is
     part of the program-cache key, so callers without times keep hitting
     their original compiled probe.
+
+    ``fleet_len`` (> 0 when a :mod:`bluefog_tpu.utils.fleetview` view is
+    armed) rides the per-rank fleet table — ``fleet_len`` extra f32
+    scalars, one ``[n, fleet_len]`` input and output — on the exact same
+    masked allgather.  The in-program merge is a per-row stamp argmax over
+    {own table} ∪ {live in-neighbor tables}: the freshest copy of every
+    rank's row wins, ties go to the local copy, and dead/zero-filled slots
+    are masked out, so the table floods the live subgraph one hop per
+    probe.  Like ``with_time``, the length is part of the program-cache
+    key: arming before warmup costs zero steady-state retraces.
     """
     n = ctx.size
     alive = np.ones(n, np.float32)
@@ -104,7 +116,14 @@ def _probe_program(ctx, sched: Optional[CommSchedule], sig,
             for k, src in enumerate(sched.in_neighbors[d]):
                 slot_alive[d, k] = alive[src]
 
-    def per_rank(tree, tvec=None):
+    if fleet_len:
+        if fleet_len % n:
+            raise ValueError(
+                f"fleet carrier length {fleet_len} not divisible by "
+                f"world size {n}")
+        row_w = fleet_len // n
+
+    def per_rank(tree, tvec=None, cvec=None):
         v = _flat_f32(jax.tree.map(lambda x: x[0], tree))
         me = lax.axis_index("rank")
         me_alive = jnp.asarray(alive)[me]
@@ -112,11 +131,21 @@ def _probe_program(ctx, sched: Optional[CommSchedule], sig,
         dist = jnp.sqrt(jnp.sum((v - vbar) ** 2)) * me_alive
         t_me = (tvec.reshape(1).astype(jnp.float32)
                 if tvec is not None else None)
-        payload = v if t_me is None else jnp.concatenate([v, t_me])
+        c_me = (cvec.reshape(-1).astype(jnp.float32)
+                if cvec is not None else None)
+        parts = [v]
+        if t_me is not None:
+            parts.append(t_me)
+        if c_me is not None:
+            parts.append(c_me)
+        payload = jnp.concatenate(parts) if len(parts) > 1 else v
         nbr_tmax = t_me
+        c_out = c_me
         if sched is not None and sched.max_in_degree > 0:
             g = ops.neighbor_allgather(payload, sched, axis="rank")
             g = g.reshape(slots, payload.shape[0])
+            if c_me is not None:
+                g, gc = g[:, :-fleet_len], g[:, -fleet_len:]
             if t_me is not None:
                 g, gt = g[:, :-1], g[:, -1]
             diffs = jnp.sqrt(jnp.sum((g - v[None, :]) ** 2, axis=1))
@@ -128,23 +157,52 @@ def _probe_program(ctx, sched: Optional[CommSchedule], sig,
             if t_me is not None:
                 nbr_tmax = jnp.max(
                     jnp.where(valid, gt, 0.0), keepdims=True)
+            if c_me is not None:
+                # stamped-row flood: per row, the freshest copy among
+                # {own table} + the live in-neighbor tables wins; invalid
+                # slots drop to stamp -inf so they never win; argmax ties
+                # resolve to index 0 — the local copy
+                tabs = jnp.concatenate(
+                    [c_me.reshape(1, n, row_w),
+                     gc.reshape(slots, n, row_w)], axis=0)
+                ok = jnp.concatenate(
+                    [jnp.ones((1,), bool), valid])
+                stamps = jnp.where(ok[:, None], tabs[:, :, 0], -jnp.inf)
+                best = jnp.argmax(stamps, axis=0)
+                c_out = jnp.take_along_axis(
+                    tabs, best[None, :, None], axis=0)[0].reshape(-1)
         else:
             disagree = jnp.zeros((), jnp.float32)
-        if t_me is None:
-            return dist[None], disagree[None]
-        return dist[None], disagree[None], t_me, nbr_tmax
+        out = [dist[None], disagree[None]]
+        if t_me is not None:
+            out += [t_me, nbr_tmax]
+        if c_me is not None:
+            out.append(c_out[None])
+        return tuple(out)
+
+    def entry(*args):
+        # positional routing: [tree, tvec?, cvec?] — the carrier must not
+        # bind to the time slot when times are absent
+        i = 1
+        tvec = None
+        if with_time:
+            tvec, i = args[i], i + 1
+        cvec = args[i] if fleet_len else None
+        return per_rank(args[0], tvec, cvec)
 
     def build():
-        n_in = 2 if with_time else 1
+        n_in = 1 + int(with_time) + int(bool(fleet_len))
         specs = tuple([P("rank")] * n_in)
-        out_specs = tuple([P("rank")] * (4 if with_time else 2))
+        n_out = 2 + 2 * int(with_time) + int(bool(fleet_len))
+        out_specs = tuple([P("rank")] * n_out)
         return jax.jit(jax.shard_map(
-            per_rank, mesh=ctx.mesh,
-            in_specs=specs if with_time else P("rank"),
+            entry, mesh=ctx.mesh,
+            in_specs=specs if n_in > 1 else P("rank"),
             out_specs=out_specs))
 
     return _mesh.cached_program(
-        ("diag-consensus", sched, ctx.mesh, sig, dead, with_time), build)
+        ("diag-consensus", sched, ctx.mesh, sig, dead, with_time,
+         fleet_len), build)
 
 
 def consensus_distance(params: Any,
@@ -199,18 +257,34 @@ def diagnose_consensus(params: Any, *,
     if dead and len(dead) >= ctx.size:
         raise ValueError(f"all {ctx.size} ranks marked dead")
     with_time = step_times is not None
+    # the fleet-view carrier rides every probe while armed (a constant
+    # program shape: arming mid-run would otherwise alternate programs
+    # and retrace after warmup); size-mismatched views (stale arming
+    # across reinit) are skipped, not fatal
+    fv = _fleetview.active()
+    if fv is not None and fv.n != ctx.size:
+        fv = None
+    carrier = fv.pre_probe(dead) if fv is not None else None
+    fleet_len = int(carrier.shape[1]) if carrier is not None else 0
     fn = _probe_program(ctx, schedule, _float_mask(params), dead,
-                        with_time=with_time)
+                        with_time=with_time, fleet_len=fleet_len)
+    inputs = [params]
     if with_time:
         t_host = np.asarray(step_times, np.float32).reshape(-1)
         if t_host.size != ctx.size:
             raise ValueError(
                 f"step_times has {t_host.size} entries for {ctx.size} ranks")
         from . import api as _api
-        dist, disagree, t_echo, nbr_tmax = fn(
-            params, _api.shard_distributed(jnp.asarray(t_host)))
-    else:
-        dist, disagree = fn(params)
+        inputs.append(_api.shard_distributed(jnp.asarray(t_host)))
+    if carrier is not None:
+        from . import api as _api
+        inputs.append(_api.shard_distributed(jnp.asarray(carrier)))
+    res = fn(*inputs)
+    dist, disagree = res[0], res[1]
+    if with_time:
+        t_echo, nbr_tmax = res[2], res[3]
+    if carrier is not None:
+        fv.post_probe(np.asarray(res[-1]), dead=dead, schedule=schedule)
     dist = np.asarray(dist)
     disagree = np.asarray(disagree)
     alive = [r for r in range(ctx.size) if r not in dead]
@@ -223,6 +297,8 @@ def diagnose_consensus(params: Any, *,
         "neighbor_disagreement_max": float(disagree.max()),
         "window_staleness": staleness,
     }
+    if carrier is not None:
+        out["fleet"] = fv.fleet()
     if with_time:
         global _last_step_times
         t = np.asarray(t_echo).reshape(-1)
@@ -712,6 +788,20 @@ class SLOEngine:
                        min_distance=round(lo, 9),
                        latest_distance=round(latest, 9))
 
+    def _check_fleet(self) -> None:
+        """A breach anywhere is a breach everywhere: when a fleet view is
+        armed, score the gossiped worst-of-fleet burn rate against the
+        same page-now threshold the local signals use and fire the
+        existing tripwire path with the origin rank attached — rank 0
+        need not be the rank that saw the breach."""
+        fv = _fleetview.active()
+        if fv is None:
+            return
+        burn, origin = fv.fleet_max("bluefog_slo_burn_rate")
+        if burn is not None and burn > self.burn_alert_threshold:
+            self._fire("slo_fast_burn", slo="fleet", window="fleet",
+                       burn=round(burn, 3), origin_rank=origin)
+
     def _check_queue_idle(self, sched) -> None:
         if sched.pending > 0 and sched.in_flight == 0:
             self._idle_streak += 1
@@ -746,6 +836,7 @@ class SLOEngine:
                 if rate is not None and rate > self.burn_alert_threshold:
                     self._fire("slo_fast_burn", slo=slo, window=short,
                                burn=round(rate, 3))
+        self._check_fleet()
         self._check_step_regression(now)
         self._check_consensus_stall(now)
         if sched is not None:

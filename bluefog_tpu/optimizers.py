@@ -1892,6 +1892,21 @@ class _InstrumentedStep:
         return out
 
 
+def _default_metrics_every_k(metrics_every_k, strategy):
+    """An armed fleet view (``BLUEFOG_FLEET_EVERY`` / ``fleetview.arm``)
+    declares a probe cadence; a step built without an explicit
+    ``metrics_every_k`` inherits it so the metric carrier actually
+    gossips — only for rank-axis strategies, the ones the probe can run
+    on."""
+    if metrics_every_k is not None:
+        return metrics_every_k
+    from .utils import fleetview as _fleetview
+    every = _fleetview.fleet_every()
+    if every is not None and strategy.axes[:1] == ("rank",):
+        return every
+    return None
+
+
 def _check_metrics_every_k(metrics_every_k, strategy):
     if metrics_every_k is None:
         return
@@ -1984,6 +1999,7 @@ def make_train_step(
     composed LM gradient recipe relies on the legacy cotangent-sum psum
     transpose (see examples/llm_3d.py and tests/test_compose.py).
     """
+    metrics_every_k = _default_metrics_every_k(metrics_every_k, strategy)
     _check_metrics_every_k(metrics_every_k, strategy)
     _check_overlap(overlap, strategy)
     if mesh is None:
@@ -2091,6 +2107,7 @@ def make_stateful_train_step(
     :func:`make_train_step` (donation here covers params, net state, and
     optimizer state — :data:`STATEFUL_TRAIN_STEP_DONATE_ARGNUMS`).
     """
+    metrics_every_k = _default_metrics_every_k(metrics_every_k, strategy)
     _check_metrics_every_k(metrics_every_k, strategy)
     _check_overlap(overlap, strategy)
     ctx = _mesh.get_context()

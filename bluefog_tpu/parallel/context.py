@@ -252,6 +252,8 @@ def init(
     _chaos.maybe_install_from_env()
     from ..utils import flight as _flight
     _flight.maybe_enable_from_env()
+    from ..utils import fleetview as _fleetview
+    _fleetview.maybe_arm_from_env(n)
     _flight.record("lifecycle", name="init", devices=n)
     if n % nodes_per_machine != 0:
         raise ValueError(

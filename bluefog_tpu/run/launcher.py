@@ -96,7 +96,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "tools/metrics_report.py)")
     p.add_argument("--metrics-port", type=int, default=None,
                    help="serve Prometheus text exposition on this port "
-                        "(sets BLUEFOG_METRICS_PORT; endpoint: /metrics)")
+                        "(sets BLUEFOG_METRICS_PORT; endpoints: /metrics, "
+                        "/healthz, and — with --fleet-view — /fleet)")
+    p.add_argument("--fleet-view", type=int, default=None, metavar="K",
+                   dest="fleet_view",
+                   help="arm in-band fleet observability: gossip the "
+                        "declared metric set on every K-th consensus "
+                        "probe (sets BLUEFOG_FLEET_EVERY; K also defaults "
+                        "metrics_every_k for train steps built without "
+                        "one; watch with tools/fleet_top.py)")
     p.add_argument("--flight-dir", default=None,
                    help="collect every rank's flight-recorder bundle in "
                         "this directory (sets BLUEFOG_FLIGHT_DIR: each "
@@ -229,6 +237,10 @@ def _child_env(args) -> dict:
         env["BLUEFOG_METRICS"] = args.metrics_filename
     if args.metrics_port is not None:
         env["BLUEFOG_METRICS_PORT"] = str(args.metrics_port)
+    if args.fleet_view is not None:
+        if args.fleet_view < 1:
+            raise SystemExit("--fleet-view must be a positive probe cadence")
+        env["BLUEFOG_FLEET_EVERY"] = str(args.fleet_view)
     if args.flight_dir:
         env["BLUEFOG_FLIGHT_DIR"] = os.path.abspath(args.flight_dir)
     if args.serve:

@@ -218,6 +218,35 @@ def _topology_block() -> Optional[dict]:
     return out
 
 
+_TS_TAIL = 256          # points per armed metric embedded in a bundle
+
+
+def _timeseries_block() -> Optional[dict]:
+    """Tails of the armed history rings (:mod:`..utils.timeseries`) —
+    postmortems carry the *trajectory* into the failure, not just last
+    values.  Guarded on the module being loaded; bounded at the last
+    ``_TS_TAIL`` points per metric so a bundle stays small.  Points are
+    ``[monotonic_ts, value]``; the ``anchor`` pairs one monotonic instant
+    with its wall time so tools can place points against the bundle's
+    wall-clock ``ts`` and event timestamps."""
+    ts_mod = sys.modules.get("bluefog_tpu.utils.timeseries")
+    if ts_mod is None:
+        return None
+    try:
+        series = {}
+        for name in ts_mod.armed_metrics():
+            pts = ts_mod.history(name)[-_TS_TAIL:]
+            if pts:
+                series[name] = [[round(float(t), 6), float(v)]
+                                for t, v in pts]
+        if not series:
+            return None
+        return {"anchor": {"mono": time.monotonic(), "wall": time.time()},
+                "series": series}
+    except Exception:                                     # pragma: no cover
+        return None
+
+
 def _metrics_block() -> Optional[dict]:
     try:
         from . import metrics as _metrics
@@ -266,6 +295,7 @@ def dump(path: Optional[str] = None, reason: str = "manual") -> str:
             "topology": _topology_block(),
             "open_spans": _open_spans_block(),
             "metrics": _metrics_block(),
+            "timeseries": _timeseries_block(),
         }
         for name, fn in list(_block_providers.items()):
             try:
@@ -294,7 +324,8 @@ _block_providers: dict = {}
 
 _RESERVED_BLOCKS = frozenset({
     "schema", "rank", "pid", "ts", "reason", "reasons", "capacity",
-    "n_events", "dropped", "events", "topology", "open_spans", "metrics"})
+    "n_events", "dropped", "events", "topology", "open_spans", "metrics",
+    "timeseries"})
 
 
 def register_block(name: str, fn) -> None:

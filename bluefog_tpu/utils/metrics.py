@@ -230,6 +230,11 @@ def _get_or_create(cls, name: str, help: str, **kw):
         elif not isinstance(m, cls) and type(m) is not cls:
             raise TypeError(
                 f"metric {name!r} already registered as {m.kind}")
+        elif help and not m.help:
+            # a read-only accessor (``counter(name)``) may have created
+            # the metric before the help-bearing site ran — backfill so
+            # the exposition carries the doc regardless of call order
+            m.help = help
         return m
 
 
@@ -307,9 +312,11 @@ def note_cache_event(hit: bool, key: Any = None) -> None:
     steady-state miss."""
     global _warned_retrace
     if hit:
-        counter("bluefog_compile_cache_hits_total").inc()
+        counter("bluefog_compile_cache_hits_total",
+                "program-cache lookups that reused a compiled program").inc()
         return
-    counter("bluefog_compile_cache_misses_total").inc()
+    counter("bluefog_compile_cache_misses_total",
+            "program-cache lookups that compiled a new program").inc()
     # registry delta worth a flight event: a compile-cache miss is the
     # signal postmortems align retraces/heals against
     _flight.record("cache_miss",
@@ -475,18 +482,48 @@ def render_prometheus() -> str:
 
 
 class _MetricsHandler(http.server.BaseHTTPRequestHandler):
-    def do_GET(self):                                    # noqa: N802
-        if self.path.rstrip("/") not in ("", "/metrics"):
-            self.send_response(404)
-            self.end_headers()
-            return
-        body = render_prometheus().encode()
-        self.send_response(200)
-        self.send_header("Content-Type",
-                         "text/plain; version=0.0.4; charset=utf-8")
+    def _reply(self, code: int, body: bytes, ctype: str) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
+
+    def do_GET(self):                                    # noqa: N802
+        path = self.path.split("?", 1)[0].rstrip("/")
+        if path in ("", "/metrics"):
+            self._reply(200, render_prometheus().encode(),
+                        "text/plain; version=0.0.4; charset=utf-8")
+            return
+        if path == "/healthz":
+            fv_mod = sys.modules.get("bluefog_tpu.utils.fleetview")
+            with _lock:
+                n_metrics = len(_registry)
+            body = json.dumps({
+                "status": "ok",
+                "ts": time.time(),
+                "pid": os.getpid(),
+                "metrics": n_metrics,
+                "fleet_armed": bool(fv_mod is not None
+                                    and fv_mod.active() is not None),
+            }).encode()
+            self._reply(200, body, "application/json")
+            return
+        if path == "/fleet":
+            # guarded on the module already being loaded: a process that
+            # never armed a fleet view must not import it from a scrape
+            fv_mod = sys.modules.get("bluefog_tpu.utils.fleetview")
+            fv = fv_mod.active() if fv_mod is not None else None
+            if fv is None:
+                self._reply(503, json.dumps(
+                    {"error": "fleet view not armed"}).encode(),
+                    "application/json")
+                return
+            self._reply(200, json.dumps(fv.fleet()).encode(),
+                        "application/json")
+            return
+        self.send_response(404)
+        self.end_headers()
 
     def log_message(self, *a):                           # scrapes are not news
         pass

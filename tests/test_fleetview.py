@@ -1,0 +1,446 @@
+"""Fleet-view tests: gossiped metric aggregation over the live topology.
+
+The centerpiece is the acceptance drill: an 8-rank CPU estate with the
+fleet carrier armed must train with ZERO post-warmup retraces and
+donation intact, and after the table floods, every rank's ``fleet()``
+must reproduce the offline ``metrics_report`` merge — counters exactly,
+gauges to f32 tolerance — with staleness within the declared
+graph-diameter bound.  Around it: the numpy ground-truth property test
+(Exp2 and Ring, through dead->join churn), the chaos contracts (a killed
+rank's row leaves every aggregate; a breach injected on a non-zero rank
+fires the tripwire/autoscaler paths fleet-wide), the /fleet and /healthz
+endpoints, the metric-help hygiene lint, and the disarmed hot-path pin.
+"""
+import importlib.util
+import json
+import os
+import time
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import bluefog_tpu as bf
+from bluefog_tpu import diagnostics as bfdiag
+from bluefog_tpu import optimizers as bfopt
+from bluefog_tpu import resilience as rz
+from bluefog_tpu import topology as tu
+from bluefog_tpu.utils import fleetview as bffleet
+from bluefog_tpu.utils import metrics as bfm
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+N, D = 8, 16
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    bfm.reset_metrics()
+    bfm.mark_steady_state(False)
+    bffleet.reset()
+    yield
+    bffleet.reset()
+    bfm.stop_metrics()
+    bfm.stop_http_server()
+    bfm.reset_metrics()
+
+
+@pytest.fixture
+def ctx(cpu_devices):
+    bf.init(devices=cpu_devices)
+    bf.set_topology(tu.ExponentialTwoGraph(N), is_weighted=True)
+    yield
+    rz.reset()
+    bf.shutdown()
+
+
+def grad_fn(params, batch):
+    loss = jnp.mean((params["w"] - batch) ** 2)
+    return loss, jax.grad(lambda p: jnp.mean((p["w"] - batch) ** 2))(params)
+
+
+def _spread_params():
+    return {"w": jnp.broadcast_to(
+        jnp.arange(float(N))[:, None], (N, D)).astype(jnp.float32)}
+
+
+# ---------------------------------------------------------------------------
+# The acceptance drill: armed carrier through a real training loop
+# ---------------------------------------------------------------------------
+
+def test_fleet_view_drill(ctx, tmp_path):
+    """8-rank estate, fleet carrier armed before warmup: donation intact,
+    retrace sentinel 0, and fleet() == the offline metrics_report merge."""
+    prefix = str(tmp_path / "train")
+    assert bfm.start_metrics(prefix)
+    port = bfm.start_http_server(0)
+
+    fv = bffleet.arm(every=2)
+    strat = bfopt.adapt_with_combine(
+        optax.sgd(0.0), bfopt.neighbor_communicator(bf.static_schedule()))
+    params = _spread_params()
+    state = bfopt.init_distributed(strat, params)
+    # no explicit metrics_every_k: the armed view's cadence is the default
+    step = bfopt.make_train_step(grad_fn, strat)
+    batch = jnp.zeros((N, D), jnp.float32)
+
+    # eager ops (first compiles included) run BEFORE warmup completes, so
+    # their cache misses cannot trip the steady-state sentinel — and they
+    # register the op-bytes counter the fleet spec carries
+    x = bf.shard_distributed(batch + 1.0)
+    bf.synchronize(bf.neighbor_allreduce(x))
+
+    sizes = []
+    w1 = None
+    for i in range(6):
+        params, state, loss = step(params, state, batch)
+        jax.block_until_ready(loss)
+        sizes.append(step._jit_cache_len())
+        if i == 0:
+            w1 = params["w"]
+    # the armed carrier changed neither donation nor the steady state
+    assert w1.is_deleted()
+    assert sizes[1] is not None and sizes[-1] == sizes[1], sizes
+    assert bfm.counter("bluefog_retrace_after_warmup_total").total() == 0
+    assert bfm.in_steady_state()
+    assert fv._round >= 3          # arm(every=2) drove the probe cadence
+
+    # counters are now frozen (probes don't bump them); flood to a fixed
+    # point so every rank's view holds every row's FINAL value.  These
+    # extra probes hit the exact program the in-step probes compiled —
+    # the sentinel assertions below would catch a new compile.
+    step_times = bfdiag.observe_step_time(0.001)
+    diam = bffleet._graph_diameter(bf.static_schedule(), frozenset())
+    out = None
+    for _ in range(diam + 1):
+        out = bfdiag.diagnose_consensus(params, step_times=step_times)
+    assert "fleet" in out
+    assert bfm.counter("bluefog_retrace_after_warmup_total").total() == 0
+
+    # offline ground truth: the JSONL log this very run wrote
+    log = bfm.stop_metrics()
+    report = _load_tool("metrics_report").report_from_files([log])
+    assert report["ok"]
+
+    for r in range(N):
+        f = fv.fleet(rank=r)
+        assert f["schema"] == bffleet.SCHEMA
+        assert f["seen_ranks"] == list(range(N))
+        st = f["staleness"]
+        assert st["bound_rounds"] == diam
+        assert st["rounds_max"] <= st["bound_rounds"]
+        # counters: exact equality with the offline merge (the shares are
+        # /8 then summed — pure f32 exponent shifts, no rounding)
+        for name in ("bluefog_train_steps_total", "bluefog_op_bytes_total"):
+            offline = sum(report["metrics"][name]["values"].values())
+            assert f["metrics"][name]["global"] == offline, (r, name)
+            assert set(f["metrics"][name]["per_rank"]) == set(range(N))
+        # gauges: every rank carried the same registry value (single
+        # process), so mean == registry to f32 cast tolerance
+        reg = bfm.gauge("bluefog_consensus_distance_max").value()
+        got = f["metrics"]["bluefog_consensus_distance_max"]["global"]
+        assert got == pytest.approx(reg, rel=1e-6)
+
+    # the worst-of-fleet fast path agrees with the table
+    mx, argmx = fv.fleet_max("bluefog_consensus_distance_max")
+    assert mx == pytest.approx(reg, rel=1e-6) and argmx in range(N)
+
+    # fleet re-exports + endpoints, live during the drill
+    body = urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metrics", timeout=10).read().decode()
+    for needle in ("bluefog_fleet_train_steps_total",
+                   "bluefog_fleet_live_ranks",
+                   "bluefog_fleet_staleness_rounds_max"):
+        assert needle in body, needle
+    doc = json.loads(urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/fleet", timeout=10).read().decode())
+    assert doc["schema"] == bffleet.SCHEMA
+    assert doc["metrics"]["bluefog_train_steps_total"]["global"] == \
+        sum(report["metrics"]["bluefog_train_steps_total"]["values"].values())
+    health = json.loads(urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/healthz", timeout=10).read().decode())
+    assert health["status"] == "ok" and health["fleet_armed"] is True
+
+
+# ---------------------------------------------------------------------------
+# Property: aggregation == numpy ground truth within diameter rounds,
+# through dead -> join churn
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("topo", ["exp2", "ring"])
+def test_fleet_aggregation_matches_numpy(cpu_devices, topo):
+    bf.init(devices=cpu_devices)
+    graph = (tu.ExponentialTwoGraph(N) if topo == "exp2"
+             else tu.RingGraph(N))
+    bf.set_topology(graph, is_weighted=True)
+    try:
+        fv = bffleet.arm()
+        # distinct per-rank signals via the attribution hook: counter
+        # overrides are the rank's raw contribution, gauges its value
+        for r in range(N):
+            fv.set_rank_override(r, "bluefog_train_steps_total", float(r + 1))
+            fv.set_rank_override(r, "bluefog_step_time_ewma_s", 0.5 * r)
+        params = _spread_params()
+
+        diam = bffleet._graph_diameter(bf.static_schedule(), frozenset())
+        assert diam >= 2           # the flood is genuinely multi-hop
+        for _ in range(diam):
+            bfdiag.diagnose_consensus(params, record=False)
+        for r in range(N):
+            f = fv.fleet(rank=r)
+            c = f["metrics"]["bluefog_train_steps_total"]
+            assert c["global"] == float(sum(range(1, N + 1)))       # exact
+            assert c["per_rank"] == {q: float(q + 1) for q in range(N)}
+            g = f["metrics"]["bluefog_step_time_ewma_s"]
+            truth = np.mean([0.5 * q for q in range(N)])
+            assert abs(g["global"] - truth) <= 1e-6
+            assert g["min"] == 0.0 and g["max"] == 0.5 * (N - 1)
+
+        # churn: kill rank 3 -> survivors converge to the 7-rank
+        # aggregate with no stale contribution from the dead row
+        rz.mark_rank_dead(3)
+        healed_diam = bffleet._graph_diameter(
+            bf.static_schedule(), frozenset({3}))
+        for _ in range(healed_diam + 1):
+            bfdiag.diagnose_consensus(params, dead_ranks=(3,), record=False)
+        for r in range(N):
+            if r == 3:
+                continue
+            f = fv.fleet(rank=r)
+            assert f["dead_ranks"] == [3]
+            c = f["metrics"]["bluefog_train_steps_total"]
+            assert c["global"] == float(sum(range(1, N + 1)) - 4)   # no 3
+            assert 3 not in c["per_rank"]
+            truth = np.mean([0.5 * q for q in range(N) if q != 3])
+            assert abs(
+                f["metrics"]["bluefog_step_time_ewma_s"]["global"]
+                - truth) <= 1e-6
+
+        # rejoin: the row re-floods and the 8-rank truth comes back
+        rz.admit_rank(3)
+        for _ in range(diam + 1):
+            bfdiag.diagnose_consensus(params, record=False)
+        f = fv.fleet(rank=0)
+        assert f["dead_ranks"] == []
+        assert f["metrics"]["bluefog_train_steps_total"]["global"] == \
+            float(sum(range(1, N + 1)))
+        assert f["staleness"]["rounds_max"] <= f["staleness"]["bound_rounds"]
+    finally:
+        rz.reset()
+        bf.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Chaos: a breach on a non-zero rank is visible (and actionable) everywhere
+# ---------------------------------------------------------------------------
+
+def test_fleet_breach_fires_tripwire_fleetwide(ctx):
+    """Rank 5 burns its error budget; rank 0's SLO engine pages with the
+    origin attached — the 'breach anywhere is a breach everywhere'
+    contract riding the existing tripwire path."""
+    fv = bffleet.arm()
+    fv.set_rank_override(5, "bluefog_slo_burn_rate", 50.0)
+    params = _spread_params()
+    diam = bffleet._graph_diameter(bf.static_schedule(), frozenset())
+    for _ in range(diam):
+        bfdiag.diagnose_consensus(params, record=False)
+
+    for r in range(N):      # every rank sees the breach and its origin
+        assert fv.fleet_max("bluefog_slo_burn_rate", rank=r) == (50.0, 5)
+
+    engine = bfdiag.SLOEngine()
+    res = engine.observe()
+    fired = [t for t in res["tripwires"] if t["kind"] == "slo_fast_burn"]
+    assert fired and fired[0]["slo"] == "fleet"
+    assert fired[0]["origin_rank"] == 5
+    assert bfm.counter("bluefog_tripwire_total").value(
+        kind="slo_fast_burn") == 1
+
+
+class _StubSched:
+    """The Scheduler surface AutoScaler drives (mirrors test_regrow)."""
+
+    def __init__(self, replicas=2, slots=4):
+        class _Obj:
+            pass
+        self.engine = _Obj()
+        self.engine.scfg = _Obj()
+        self.engine.scfg.slots = slots
+        self.engine.m = _Obj()
+        self.engine.m.slice_size = 1
+        self.replicas = replicas
+        self._dead = set()
+        self._parked = set()
+        self.pending = 0
+        self.restored = []
+
+    def live_replicas(self):
+        return [r for r in range(self.replicas) if r not in self._dead]
+
+    def restore_replica(self, r):
+        self._dead.discard(r)
+        self._parked.discard(r)
+        self.restored.append(r)
+        return True
+
+    def fail_replica(self, r, reason="failed", park=False):
+        self._dead.add(r)
+        if park:
+            self._parked.add(r)
+        return []
+
+
+def _flood_hostside(fv):
+    """Emulate a fully-flooded table without a mesh: every rank's view
+    becomes the stamped own-rows of all ranks (what diameter rounds of
+    the compiled merge converge to)."""
+    carrier = fv.pre_probe()
+    t = carrier.reshape(fv.n, fv.n, fv.row_width)
+    rows = np.stack([t[r, r] for r in range(fv.n)])
+    fv.post_probe(np.broadcast_to(
+        rows, (fv.n, fv.n, fv.row_width)).reshape(fv.n, -1))
+
+
+def test_autoscaler_acts_on_remote_queue_breach():
+    """A queue flood on another rank grows the fleet from here: the rank
+    holding the parked replica acts on the gossiped signal even though
+    its local queue is empty."""
+    from bluefog_tpu.serve.scheduler import AutoScaler
+    fv = bffleet.arm(n=N)
+    fv.set_rank_override(3, "bluefog_serve_queue_depth", 99.0)
+    _flood_hostside(fv)
+
+    sched = _StubSched()
+    sched.fail_replica(1, reason="parked", park=True)   # parked reserve
+    sc = AutoScaler(sched, slo_p99_s=0.25, queue_high=4, cooldown_steps=1)
+    sched.pending = 0                                   # locally calm
+    ev = sc.observe()
+    assert ev and ev["action"] == "grow" and sched.restored == [1]
+
+    # and without the fleet signal the same local state stays calm
+    bffleet.reset()
+    sched2 = _StubSched()
+    sched2.fail_replica(1, reason="parked", park=True)
+    sc2 = AutoScaler(sched2, slo_p99_s=0.25, queue_high=4, cooldown_steps=1)
+    assert sc2.observe() is None and sched2.restored == []
+
+
+# ---------------------------------------------------------------------------
+# Endpoints + the fleet_top tool surface
+# ---------------------------------------------------------------------------
+
+def test_fleet_endpoint_unarmed_503_and_healthz():
+    port = bfm.start_http_server(0)
+    health = json.loads(urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/healthz", timeout=10).read().decode())
+    assert health["status"] == "ok"
+    assert health["fleet_armed"] is False
+    assert health["metrics"] >= 0
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(f"http://127.0.0.1:{port}/fleet", timeout=10)
+    assert ei.value.code == 503
+    assert b"not armed" in ei.value.read()
+
+
+def test_fleet_endpoint_and_fleet_top_render():
+    """Armed host-side view over HTTP -> fleet_top's schema check, table
+    render, and the string-keyed per_rank path after the JSON round trip."""
+    fv = bffleet.arm(n=4)
+    for r in range(4):
+        fv.set_rank_override(r, "bluefog_step_time_ewma_s", 0.1 * (r + 1))
+        fv.set_rank_override(r, "bluefog_train_steps_total", 10.0)
+    _flood_hostside(fv)
+
+    port = bfm.start_http_server(0)
+    doc = json.loads(urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/fleet", timeout=10).read().decode())
+
+    ft = _load_tool("fleet_top")
+    ft.check_schema(doc)
+    assert ft._per_rank(doc, "bluefog_step_time_ewma_s", 3) == \
+        pytest.approx(0.4, rel=1e-6)                  # "3" after round trip
+    text = ft.render(doc)
+    assert "4/4 ranks live" in text
+    assert "train_steps_total=40" in text
+    with pytest.raises(ValueError):
+        ft.check_schema({"schema": "wrong"})
+
+
+# ---------------------------------------------------------------------------
+# Hygiene lint + hot-path pin
+# ---------------------------------------------------------------------------
+
+def test_metric_help_and_type_hygiene(ctx):
+    """Every bluefog_* metric a real run registers carries non-empty help
+    and a stable type, and the Prometheus exporter emits the matching
+    # HELP / # TYPE pair — scrapes must stay self-describing."""
+    bffleet.arm(every=1)
+    strat = bfopt.adapt_with_combine(
+        optax.sgd(0.0), bfopt.neighbor_communicator(bf.static_schedule()))
+    params = _spread_params()
+    state = bfopt.init_distributed(strat, params)
+    step = bfopt.make_train_step(grad_fn, strat)
+    batch = jnp.zeros((N, D), jnp.float32)
+    for _ in range(3):
+        params, state, loss = step(params, state, batch)
+    jax.block_until_ready(loss)
+
+    snap = bfm.snapshot()
+    assert sum(1 for n in snap if n.startswith("bluefog_")) >= 10
+    body = bfm.render_prometheus()
+    for name, doc in snap.items():
+        if not name.startswith("bluefog_"):
+            continue
+        assert doc.get("help"), f"{name} has no help text"
+        assert doc.get("type") in ("counter", "gauge", "histogram"), name
+        assert f"# HELP {name} " in body, name
+        assert f"# TYPE {name} {doc['type']}" in body, name
+
+    # read-only accessors must not strip help from an existing metric
+    before = bfm.counter("bluefog_train_steps_total").help
+    assert before and bfm.counter("bluefog_train_steps_total").help == before
+
+
+def test_fleet_hot_path_cost_pin():
+    """Disarmed, the probe path pays ONE global read — pin it so the
+    carrier can never grow a hidden per-step cost; armed, a full
+    snapshot/publish round stays sub-millisecond-ish per PROBE."""
+    bffleet.reset()
+    n = 100_000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        bffleet.active()
+    disarmed = (time.perf_counter() - t0) / n
+    assert disarmed < 5e-6, f"disarmed fleet check {disarmed:.2e}s/call"
+
+    fv = bffleet.arm(n=N)
+    t0 = time.perf_counter()
+    for _ in range(20):
+        _flood_hostside(fv)
+    armed = (time.perf_counter() - t0) / 20
+    assert armed < 5e-3, f"armed probe round {armed:.2e}s"
+
+
+def test_arm_from_env_and_validation(monkeypatch):
+    monkeypatch.setenv("BLUEFOG_FLEET_EVERY", "3")
+    fv = bffleet.maybe_arm_from_env(N)
+    assert fv is not None and fv.every == 3 and bffleet.fleet_every() == 3
+    bffleet.reset()
+    monkeypatch.setenv("BLUEFOG_FLEET_EVERY", "zero")
+    assert bffleet.maybe_arm_from_env(N) is None     # warned, not fatal
+    assert bffleet.active() is None
+    with pytest.raises(ValueError):
+        bffleet.FleetView(N, spec=())
+    with pytest.raises(ValueError):
+        bffleet.FleetView(N, every=0)

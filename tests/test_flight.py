@@ -402,3 +402,78 @@ def test_launcher_collects_bundles_and_postmortem_blames_dead_rank(
         pr = doc["per_rank"][str(r)]
         assert "sigterm" in pr["reasons"]
         assert pr["last_step"] >= 30
+
+
+# ---------------------------------------------------------------------------
+# Timeseries tails in bundles, postmortem trajectories, report windows
+# ---------------------------------------------------------------------------
+
+def test_dump_embeds_timeseries_tails_and_postmortem_plots(tmp_path):
+    from bluefog_tpu.utils import timeseries as ts
+    ts.arm("bluefog_step_time_ewma_s", capacity=512)
+    try:
+        # flat 0.1s step time with a 2x ramp over the last 10 points —
+        # exactly the shape a postmortem should surface at a glance
+        for i in range(300):
+            ts.append("bluefog_step_time_ewma_s",
+                      0.2 if i >= 290 else 0.1, ts=float(i))
+        bundle = json.load(open(flight.dump(str(tmp_path / "b.json"),
+                                            reason="probe")))
+        blk = bundle["timeseries"]
+        assert {"mono", "wall"} <= set(blk["anchor"])
+        pts = blk["series"]["bluefog_step_time_ewma_s"]
+        assert len(pts) == flight._TS_TAIL       # ring tail, bounded
+        assert pts[-1][1] == pytest.approx(0.2)
+
+        # postmortem turns the embedded tails into per-rank trajectories
+        doc = postmortem.analyze({0: bundle})
+        traj = doc["timeseries"]["bluefog_step_time_ewma_s"]["0"]
+        assert traj["n"] == len(pts)
+        assert traj["last"] == pytest.approx(0.2)
+        assert traj["median"] == pytest.approx(0.1)
+        assert traj["last_over_median"] == pytest.approx(2.0)
+        assert traj["spark"] and len(traj["spark"]) <= 64
+        assert len(traj["points"]) <= 64
+        # points are re-anchored to wall clock via the bundle anchor
+        off = blk["anchor"]["wall"] - blk["anchor"]["mono"]
+        assert traj["points"][-1][0] == pytest.approx(
+            pts[-1][0] + off, abs=1e-3)
+    finally:
+        ts.reset()
+    # bundles without the block (older dumps) stay readable: no key
+    doc2 = postmortem.analyze({0: {k: v for k, v in bundle.items()
+                                   if k != "timeseries"}})
+    assert "timeseries" not in doc2
+
+
+def test_metrics_report_since_last_window(tmp_path):
+    # window_bounds: later bound wins when --since and --last combine
+    assert metrics_report.window_bounds(since=50.0, last=10.0,
+                                        now=100.0) == 90.0
+    assert metrics_report.window_bounds(since=95.0, last=10.0,
+                                        now=100.0) == 95.0
+    assert metrics_report.window_bounds() is None
+    with pytest.raises(ValueError):
+        metrics_report.window_bounds(last=0)
+
+    def line(ts, ewma):
+        m = {"bluefog_step_time_ewma_s":
+                 {"type": "gauge", "help": "h", "values": {"": ewma}}}
+        doc = {"host": 0, "metrics": m}
+        if ts is not None:
+            doc["ts"] = ts
+        return json.dumps(doc)
+
+    log = tmp_path / "h0.metrics.jsonl"
+    log.write_text("\n".join([line(None, 0.3),     # ts-less: kept + noted
+                              line(100.0, 0.2),
+                              line(200.0, 0.1)]) + "\n")
+    full = metrics_report.report_from_files([str(log)])
+    assert "window" not in full and full["n_samples"] == 3
+    assert len(full["series"]["bluefog_step_time_ewma_s"]) == 3
+
+    doc = metrics_report.report_from_files([str(log)], since=150.0)
+    assert doc["window"] == {"since_ts": 150.0}
+    assert doc["n_samples"] == 2                   # ts-less survivor + 200.0
+    assert len(doc["series"]["bluefog_step_time_ewma_s"]) == 2
+    assert any("without a ts kept" in n for n in doc["notes"])

@@ -603,3 +603,38 @@ def test_tracing_and_timeseries_overhead_invariants(cpu_devices, tmp_path):
     assert bfts.history(_LAT), "armed latency ring must have filled"
     assert len(bftrace.spans()) > 0
     sched.close()
+
+
+def test_trace_report_since_last_window(tmp_path):
+    tr = _load_tool("tools/trace_report")
+    # window_bounds: later bound wins; non-positive --last rejected
+    assert tr.window_bounds(since=50.0, last=10.0, now=100.0) == 90.0
+    assert tr.window_bounds(since=95.0, last=10.0, now=100.0) == 95.0
+    assert tr.window_bounds() is None
+    with pytest.raises(ValueError):
+        tr.window_bounds(last=-1)
+
+    # anchor with wall == mono so span endpoints read as wall times
+    lines = [json.dumps({"kind": "meta", "schema": "bluefog-trace-1",
+                         "rank": 0, "mono": 0.0, "wall": 0.0}),
+             json.dumps({"kind": "span", "seq": 0, "trace": "t", "span": 1,
+                         "name": "train_step", "cat": "train",
+                         "t0": 1.0, "t1": 5.0, "step": 1}),
+             json.dumps({"kind": "span", "seq": 1, "trace": "t", "span": 2,
+                         "name": "train_step", "cat": "train",
+                         "t0": 8.0, "t1": 12.0, "step": 2})]
+    p = tmp_path / "w.trace.jsonl"
+    p.write_text("\n".join(lines) + "\n")
+
+    doc, _ = tr.report_from_files([str(p)])
+    assert doc["n_spans"] == 2 and "window" not in doc
+
+    # the span that *ended* before the cut is dropped (and noted)...
+    doc, _ = tr.report_from_files([str(p)], since=6.0)
+    assert doc["n_spans"] == 1 and doc["train"]["steps"] == 1
+    assert doc["window"] == {"since_ts": 6.0}
+    assert any("dropped 1 span" in n for n in doc["notes"])
+
+    # ...but a span still *running into* the window is kept: t1 inside
+    doc, _ = tr.report_from_files([str(p)], since=4.0)
+    assert doc["n_spans"] == 2

@@ -57,6 +57,8 @@ MODULES = [
     ("bluefog_tpu.utils.metrics", "Live metrics registry + exporters"),
     ("bluefog_tpu.utils.tracing", "Request-scoped span tracing"),
     ("bluefog_tpu.utils.timeseries", "Bounded metric history rings"),
+    ("bluefog_tpu.utils.fleetview",
+     "Fleet view (gossiped whole-fleet metric carrier)"),
     ("bluefog_tpu.diagnostics", "Consensus-health probes + peer health"),
     ("bluefog_tpu.utils.watchdog", "Stall watchdog"),
     ("bluefog_tpu.resilience", "Fault tolerance (healing + rollback)"),

@@ -308,6 +308,17 @@ def _battery_steps(tag: str, stage: int = 0) -> list:
                    "--async-frontier",
                    "--out", os.path.join(m, f"async_frontier_{tag}.json")],
                   1200, None, None))
+    ftop = os.path.join(REPO, "tools", "fleet_top.py")
+    if os.path.exists(ftop):
+        # the fleet-view row: train an 8-rank estate with the gossip
+        # carrier armed, scrape its own /fleet endpoint, and bank the
+        # frame — gated in-tool on the schema + zero-retrace/health
+        # invariants (the drill grades the carrier's donation/retrace
+        # contract, not accelerator perf, so it pins jax to CPU itself)
+        steps.append(("fleet_view",
+                      [py, ftop, "--virtual-cpu", "--once", "--json",
+                       "--out", os.path.join(m, f"fleet_view_{tag}.json")],
+                      600, None, None))
     pb = os.path.join(REPO, "tools", "preempt_bench.py")
     if os.path.exists(pb):
         # the preemptible-fleet grader: a mass spot reclaim replayed
@@ -447,6 +458,11 @@ def _rehearsal_steps(tag: str) -> list:
          [py, os.path.join(REPO, "tools", "gossip_bench.py"),
           "--async-frontier", "--virtual-cpu", "--params", "2048",
           "--out", os.path.join(m, f"async_frontier_{tag}.json")], 600,
+         None, None),
+        ("fleet_view",
+         [py, os.path.join(REPO, "tools", "fleet_top.py"),
+          "--virtual-cpu", "--once", "--json",
+          "--out", os.path.join(m, f"fleet_view_{tag}.json")], 600,
          None, None),
         ("preempt_trace",
          [py, os.path.join(REPO, "tools", "preempt_trace.py"),

@@ -214,11 +214,52 @@ def _summary(merged):
     return out
 
 
-def report_from_files(paths):
+def window_bounds(since=None, last=None, now=None):
+    """Resolve ``--since <wall-ts>`` / ``--last <secs>`` into one lower
+    wall-clock bound (None = no filtering).  Both given: the later bound
+    wins — the caller asked for the intersection."""
+    if since is None and last is None:
+        return None
+    bounds = []
+    if since is not None:
+        bounds.append(float(since))
+    if last is not None:
+        if last <= 0:
+            raise ValueError(f"--last must be > 0 seconds, got {last}")
+        import time
+        bounds.append((time.time() if now is None else float(now))
+                      - float(last))
+    return max(bounds)
+
+
+def filter_samples(samples, cut, notes=None, label=""):
+    """Keep the snapshots at or after wall time ``cut`` (samples without
+    a ``ts`` are kept: better a too-wide window than silently dropped
+    data, and each such keep is noted)."""
+    if cut is None:
+        return samples
+    kept, missing = [], 0
+    for s in samples:
+        ts = s.get("ts")
+        if ts is None:
+            missing += 1
+            kept.append(s)
+        elif float(ts) >= cut:
+            kept.append(s)
+    if missing and notes is not None:
+        notes.append(f"{label}: {missing} sample(s) without a ts kept "
+                     "despite the --since/--last window")
+    return kept
+
+
+def report_from_files(paths, since=None, last=None):
+    cut = window_bounds(since, last)
     host_samples = {}
     load_notes = []
     for i, path in enumerate(paths):
         samples = load_samples(path, notes=load_notes)
+        samples = filter_samples(samples, cut, notes=load_notes,
+                                 label=os.path.basename(path))
         # the host id rides in each line; fall back to the file position so
         # two single-host simulations on one machine still merge as two
         host = samples[-1].get("host", i) if samples else i
@@ -226,6 +267,8 @@ def report_from_files(paths):
             host = max(host_samples) + 1
         host_samples[host] = samples
     report = merge(host_samples)
+    if cut is not None:
+        report["window"] = {"since_ts": cut}
     if load_notes:
         report.setdefault("notes", [])[:0] = load_notes
     return report
@@ -235,10 +278,18 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("logs", nargs="+", help="per-host *.metrics.jsonl files")
     ap.add_argument("--out", default=None)
+    ap.add_argument("--since", type=float, default=None, metavar="WALL_TS",
+                    help="only merge snapshots at/after this wall-clock "
+                         "unix timestamp (slice a long-run log without "
+                         "pre-splitting the JSONL)")
+    ap.add_argument("--last", type=float, default=None, metavar="SECS",
+                    help="only merge snapshots from the trailing SECS "
+                         "seconds (combines with --since: later bound "
+                         "wins)")
     args = ap.parse_args()
     try:
-        doc = report_from_files(args.logs)
-    except OSError as e:
+        doc = report_from_files(args.logs, since=args.since, last=args.last)
+    except (OSError, ValueError) as e:
         doc = {"ok": False, "error": f"{type(e).__name__}: {e}"[:300]}
     print(json.dumps(doc))
     if args.out:

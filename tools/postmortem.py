@@ -390,6 +390,54 @@ def _preempt_block(bundles, notes):
     return out
 
 
+_SPARK_LEVELS = "▁▂▃▄▅▆▇█"
+_LEAD_IN_POINTS = 64                 # trajectory points kept per rank/metric
+
+
+def _sparkline(vals):
+    """Min-max normalized unicode sparkline (the terminal 'plot')."""
+    if not vals:
+        return ""
+    lo, hi = min(vals), max(vals)
+    span = hi - lo
+    if span <= 0:
+        return _SPARK_LEVELS[0] * len(vals)
+    return "".join(_SPARK_LEVELS[min(7, int((v - lo) / span * 8))]
+                   for v in vals)
+
+
+def _timeseries_trajectories(bundles):
+    """The armed history rings each bundle embeds (flight's
+    ``timeseries`` block): per metric, per rank, the tail trajectory
+    leading into the dump — wall-clock points (via the bundle's
+    mono/wall anchor), a sparkline, and last-vs-median so a step-time
+    ramp or burn-rate spike into the verdict step reads at a glance."""
+    out = {}
+    for rank, bundle in sorted(bundles.items()):
+        blk = bundle.get("timeseries")
+        if not isinstance(blk, dict):
+            continue
+        anchor = blk.get("anchor") or {}
+        off = float(anchor.get("wall", 0.0)) - float(anchor.get("mono", 0.0))
+        for name, pts in sorted((blk.get("series") or {}).items()):
+            vals = [float(v) for _, v in pts]
+            if not vals:
+                continue
+            tail = pts[-_LEAD_IN_POINTS:]
+            mid = sorted(vals)[len(vals) // 2]
+            ent = {
+                "n": len(vals),
+                "last": vals[-1],
+                "median": mid,
+                "last_over_median": (vals[-1] / mid) if mid else None,
+                "spark": _sparkline([float(v) for _, v in tail]),
+                "points": [[round(float(t) + off, 3), float(v)]
+                           for t, v in tail],
+            }
+            out.setdefault(name, {})[str(rank)] = ent
+    return out or None
+
+
 def analyze(bundles, notes=None, torn=()):
     """``{rank: bundle}`` -> postmortem report dict."""
     notes = notes if notes is not None else []
@@ -452,6 +500,9 @@ def analyze(bundles, notes=None, torn=()):
         "consensus": _consensus_trajectory(bundles),
         "topology": _topology_block(bundles, notes),
     }
+    trajectories = _timeseries_trajectories(bundles)
+    if trajectories is not None:
+        report["timeseries"] = trajectories
     serve = _serve_block(bundles, notes)
     if serve is not None:
         report["serve"] = serve

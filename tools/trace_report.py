@@ -39,6 +39,7 @@ import glob
 import json
 import os
 import sys
+import time
 
 SCHEMA = "bluefog-trace-report-1"
 BUNDLE_SCHEMA = "bluefog-trace-1"
@@ -180,9 +181,43 @@ def train_summary(bundles):
             "probes": probes}
 
 
-def report_from_files(paths):
+def window_bounds(since=None, last=None, now=None):
+    """``--since <wall-ts>`` / ``--last <secs>`` -> one lower wall-clock
+    bound (None = keep everything; both given: later bound wins)."""
+    if since is None and last is None:
+        return None
+    bounds = []
+    if since is not None:
+        bounds.append(float(since))
+    if last is not None:
+        if last <= 0:
+            raise ValueError(f"--last must be > 0 seconds, got {last}")
+        bounds.append((time.time() if now is None else float(now))
+                      - float(last))
+    return max(bounds)
+
+
+def filter_bundles(bundles, cut):
+    """Drop spans that *ended* before wall time ``cut`` (a span still
+    running into the window counts: its tail is inside)."""
+    if cut is None:
+        return bundles
+    return [(meta,
+             [s for s in spans if _wall(meta, s["t1"]) >= cut])
+            for meta, spans in bundles]
+
+
+def report_from_files(paths, since=None, last=None):
     notes = []
+    cut = window_bounds(since, last)
     bundles = [load_bundle(p, notes=notes) for p in paths]
+    if cut is not None:
+        before = sum(len(s) for _, s in bundles)
+        bundles = filter_bundles(bundles, cut)
+        dropped = before - sum(len(s) for _, s in bundles)
+        if dropped:
+            notes.append(f"window filter dropped {dropped} span(s) "
+                         f"ending before {cut:.3f}")
     reqs = critical_path(bundles)
     table = sorted(
         ([t, v["total_s"], v["queue_s"], v["prefill_s"], v["decode_s"],
@@ -199,6 +234,8 @@ def report_from_files(paths):
         "critical_path": table,
         "train": train_summary(bundles),
     }
+    if cut is not None:
+        doc["window"] = {"since_ts": cut}
     if notes:
         doc["notes"] = notes
     return doc, bundles
@@ -213,6 +250,13 @@ def main():
     ap.add_argument("--out", default=None, help="write the report JSON here")
     ap.add_argument("--chrome", default=None,
                     help="write a chrome://tracing file here")
+    ap.add_argument("--since", type=float, default=None, metavar="WALL_TS",
+                    help="only report spans ending at/after this wall-clock "
+                         "unix timestamp (slice a long-run artifact without "
+                         "pre-splitting the JSONL)")
+    ap.add_argument("--last", type=float, default=None, metavar="SECS",
+                    help="only report spans from the trailing SECS seconds "
+                         "(combines with --since: later bound wins)")
     args = ap.parse_args()
     paths = list(args.bundles)
     if args.dir:
@@ -221,7 +265,8 @@ def main():
         print(json.dumps({"ok": False, "error": "no bundles given"}))
         sys.exit(1)
     try:
-        doc, bundles = report_from_files(paths)
+        doc, bundles = report_from_files(paths, since=args.since,
+                                         last=args.last)
     except (OSError, ValueError) as e:
         doc = {"ok": False, "error": f"{type(e).__name__}: {e}"[:300]}
         bundles = None
